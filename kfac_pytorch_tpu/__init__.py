@@ -1,0 +1,18 @@
+"""kfac_pytorch_tpu — TPU-native distributed K-FAC second-order optimizer.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``kfac_pytorch`` library (a Horovod/CUDA distributed K-FAC gradient
+preconditioner, see /root/reference/kfac/kfac_preconditioner.py): per-layer
+Kronecker-factored curvature estimation, distributed eigendecomposition, and
+natural-gradient preconditioning — expressed as pure functions over explicit
+state, sharded with ``jax.sharding.Mesh`` + ``shard_map``, and compiled as a
+single XLA program per train step.
+
+Target public API (parity with ``from kfac import KFAC, KFACParamScheduler``,
+reference kfac/__init__.py:1-2) — re-exported here once the preconditioner
+module lands:
+
+    from kfac_pytorch_tpu import KFAC, KFACParamScheduler
+"""
+
+__version__ = "0.1.0"

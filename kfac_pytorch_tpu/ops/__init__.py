@@ -1,0 +1,52 @@
+"""Pure K-FAC math kernels: factor statistics, eigendecomposition, preconditioning.
+
+TPU-native replacement for the reference's factor math (kfac/utils.py) and the
+per-layer eigen/precondition steps (kfac/kfac_preconditioner.py:196-309). All
+functions are pure, jit-able, and use explicit ``lax.Precision.HIGHEST`` on
+matmuls that feed eigendecompositions (TPU default bf16 matmuls would wreck
+factor conditioning).
+"""
+
+from kfac_pytorch_tpu.ops.factors import (
+    compute_a_conv,
+    compute_a_dense,
+    compute_g_conv,
+    compute_g_dense,
+    conv_kernel_to_mat,
+    dense_kernel_to_mat,
+    extract_patches,
+    grads_to_mat,
+    mat_to_conv_kernel,
+    mat_to_dense_kernel,
+    mat_to_grads,
+    update_running_avg,
+)
+from kfac_pytorch_tpu.ops.eigh import (
+    blocked_eigh,
+    eigh_with_floor,
+    get_block_boundary,
+)
+from kfac_pytorch_tpu.ops.precondition import (
+    kl_clip_coefficient,
+    precondition_mat,
+)
+
+__all__ = [
+    "compute_a_conv",
+    "compute_a_dense",
+    "compute_g_conv",
+    "compute_g_dense",
+    "conv_kernel_to_mat",
+    "dense_kernel_to_mat",
+    "extract_patches",
+    "grads_to_mat",
+    "mat_to_conv_kernel",
+    "mat_to_dense_kernel",
+    "mat_to_grads",
+    "update_running_avg",
+    "blocked_eigh",
+    "eigh_with_floor",
+    "get_block_boundary",
+    "kl_clip_coefficient",
+    "precondition_mat",
+]

@@ -1,0 +1,226 @@
+"""Kronecker factor statistics (A = input covariance, G = grad-output covariance).
+
+Behavioral parity with the reference factor math (kfac/utils.py:56-183):
+
+* ``compute_a_dense`` / ``compute_a_conv``  — reference ``ComputeA.linear`` /
+  ``ComputeA.conv2d`` (kfac/utils.py:90-128).
+* ``compute_g_dense`` / ``compute_g_conv``  — reference ``ComputeG.linear`` /
+  ``ComputeG.conv2d`` (kfac/utils.py:131-183).
+* ``extract_patches`` — reference ``_extract_patches`` (kfac/utils.py:56-77),
+  realised as ``lax.conv_general_dilated_patches`` (XLA's native im2col, which
+  tiles onto the MXU) instead of a double ``Tensor.unfold``.
+* ``update_running_avg`` — reference kfac/utils.py:80-87. NOTE: the reference
+  docstring there is wrong; the *code* computes
+  ``current = alpha * current + (1 - alpha) * new`` and that is what we match.
+
+Layout conventions (TPU/flax native, NOT torch):
+  * activations NHWC, conv kernels HWIO ``[kh, kw, in, out]``,
+    dense kernels ``[in, out]``.
+  * the "factor-space" gradient matrix is ``[out, in * kh * kw (+1 bias)]``,
+    matching the channel-major patch feature ordering of
+    ``conv_general_dilated_patches`` (verified by test_factors.py roundtrips).
+
+All matmuls feeding factors use ``lax.Precision.HIGHEST`` so TPU bf16 matmul
+defaults cannot corrupt the eigendecompositions downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+_HIGHEST = lax.Precision.HIGHEST
+
+Padding = Union[str, Sequence[Tuple[int, int]]]
+
+
+def _as_pairs(padding: Padding) -> Padding:
+    """Normalize int / int-pair padding into conv_general padding pairs."""
+    if isinstance(padding, str):
+        return padding
+    pairs = []
+    for p in padding:
+        if isinstance(p, int):
+            pairs.append((p, p))
+        else:
+            pairs.append(tuple(p))
+    return tuple(pairs)
+
+
+def extract_patches(
+    x: jnp.ndarray,
+    kernel_size: Tuple[int, int],
+    strides: Tuple[int, int],
+    padding: Padding,
+    kernel_dilation: Tuple[int, int] = (1, 1),
+) -> jnp.ndarray:
+    """im2col: ``[B, H, W, C] -> [B, out_h, out_w, C * kh * kw]``.
+
+    Feature dim is channel-major ``(c, kh, kw)``, matching
+    ``conv_kernel_to_mat`` column ordering. Parity: kfac/utils.py:56-77.
+    """
+    return lax.conv_general_dilated_patches(
+        x,
+        filter_shape=tuple(kernel_size),
+        window_strides=tuple(strides),
+        padding=_as_pairs(padding),
+        rhs_dilation=tuple(kernel_dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _flatten_leading(x: jnp.ndarray) -> jnp.ndarray:
+    """``[..., d] -> [N, d]`` — dense layers may see [B, d] or [B, T, d]."""
+    return x.reshape(-1, x.shape[-1])
+
+
+def compute_a_dense(a: jnp.ndarray, has_bias: bool) -> jnp.ndarray:
+    """Input covariance for a dense layer: ``A = aᵀ (a / N)``.
+
+    With bias, activations gain a homogeneous-coordinate column of ones so the
+    bias is folded into the same Kronecker factor. Parity: kfac/utils.py:119-128.
+    """
+    a = _flatten_leading(a)
+    n = a.shape[0]
+    if has_bias:
+        ones = jnp.ones((n, 1), dtype=a.dtype)
+        a = jnp.concatenate([a, ones], axis=1)
+    return jnp.matmul(a.T, a / n, precision=_HIGHEST)
+
+
+def compute_a_conv(
+    a: jnp.ndarray,
+    kernel_size: Tuple[int, int],
+    strides: Tuple[int, int],
+    padding: Padding,
+    has_bias: bool,
+    kernel_dilation: Tuple[int, int] = (1, 1),
+) -> jnp.ndarray:
+    """Input covariance for a conv layer from NHWC activations.
+
+    Patch-extract, append bias column, scale by 1/spatial_size, then
+    ``A = aᵀ (a / B)`` with B the *batch* size (sum runs over B·oh·ow rows).
+    Parity: kfac/utils.py:107-117 — including the bias column being appended
+    *before* the 1/spatial division (so its entries are 1/spatial_size).
+    """
+    batch_size = a.shape[0]
+    patches = extract_patches(a, kernel_size, strides, padding, kernel_dilation)
+    spatial_size = patches.shape[1] * patches.shape[2]
+    p = patches.reshape(-1, patches.shape[-1])
+    if has_bias:
+        ones = jnp.ones((p.shape[0], 1), dtype=p.dtype)
+        p = jnp.concatenate([p, ones], axis=1)
+    p = p / spatial_size
+    return jnp.matmul(p.T, p / batch_size, precision=_HIGHEST)
+
+
+def compute_g_dense(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
+    """Grad-output covariance for a dense layer.
+
+    ``G = gᵀ (g · N)`` when the loss was batch-averaged (undoes the 1/N the
+    mean loss baked into each row, then averages the N outer products), else
+    ``G = gᵀ (g / N)``. Parity: kfac/utils.py:172-183.
+    """
+    g = _flatten_leading(g)
+    n = g.shape[0]
+    if batch_averaged:
+        return jnp.matmul(g.T, g * n, precision=_HIGHEST)
+    return jnp.matmul(g.T, g / n, precision=_HIGHEST)
+
+
+def compute_g_conv(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
+    """Grad-output covariance for a conv layer from NHWC output-grads.
+
+    Reshape ``[B, oh, ow, C] -> [B·oh·ow, C]``, rescale (×B if batch-averaged,
+    ×spatial always), then ``G = gᵀ (g / (B·oh·ow))``.
+    Parity: kfac/utils.py:155-170 (torch transposes NCHW→NHWC first; our
+    activations are already NHWC so only the reshape remains).
+    """
+    batch_size = g.shape[0]
+    spatial_size = g.shape[1] * g.shape[2]
+    gm = g.reshape(-1, g.shape[-1])
+    if batch_averaged:
+        gm = gm * batch_size
+    gm = gm * spatial_size
+    return jnp.matmul(gm.T, gm / gm.shape[0], precision=_HIGHEST)
+
+
+def update_running_avg(
+    new: jnp.ndarray, current: jnp.ndarray, alpha: float
+) -> jnp.ndarray:
+    """EMA with ``alpha`` weight on *history*: ``alpha·current + (1-alpha)·new``.
+
+    Matches the reference CODE (kfac/utils.py:85-87), not its docstring; with
+    the default ``factor_decay=0.95`` each update keeps 95% history / 5% new.
+    Functional (returns the new value) rather than in-place.
+    """
+    return alpha * current + (1.0 - alpha) * new
+
+
+# ---------------------------------------------------------------------------
+# Factor-space <-> parameter-space reshapes
+# ---------------------------------------------------------------------------
+
+
+def conv_kernel_to_mat(kernel: jnp.ndarray) -> jnp.ndarray:
+    """HWIO conv kernel ``[kh, kw, in, out] -> [out, in*kh*kw]``.
+
+    Column ordering (in, kh, kw) matches the channel-major patch features of
+    ``extract_patches``, so factor A's index space aligns with these columns.
+    (The torch analog is weight.view(out, -1), kfac_preconditioner.py:279-281.)
+    """
+    kh, kw, cin, cout = kernel.shape
+    return jnp.transpose(kernel, (3, 2, 0, 1)).reshape(cout, cin * kh * kw)
+
+
+def mat_to_conv_kernel(mat: jnp.ndarray, kernel_shape) -> jnp.ndarray:
+    """Inverse of :func:`conv_kernel_to_mat`."""
+    kh, kw, cin, cout = kernel_shape
+    return jnp.transpose(mat.reshape(cout, cin, kh, kw), (2, 3, 1, 0))
+
+
+def dense_kernel_to_mat(kernel: jnp.ndarray) -> jnp.ndarray:
+    """Flax dense kernel ``[in, out] -> [out, in]`` (factor-space layout)."""
+    return kernel.T
+
+
+def mat_to_dense_kernel(mat: jnp.ndarray, kernel_shape) -> jnp.ndarray:
+    """Inverse of :func:`dense_kernel_to_mat`."""
+    del kernel_shape
+    return mat.T
+
+
+def grads_to_mat(layer_grads: Dict[str, Any]) -> jnp.ndarray:
+    """Layer grad dict ``{'kernel': ..., 'bias'?: ...}`` → ``[out, in(+1)]``.
+
+    Conv kernels are flattened channel-major; a bias grad becomes the final
+    column (homogeneous coordinate). Parity: kfac_preconditioner.py:270-286.
+    """
+    kernel = layer_grads["kernel"]
+    if kernel.ndim == 4:
+        mat = conv_kernel_to_mat(kernel)
+    elif kernel.ndim == 2:
+        mat = dense_kernel_to_mat(kernel)
+    else:
+        raise ValueError(f"unsupported kernel rank: {kernel.shape}")
+    if "bias" in layer_grads:
+        mat = jnp.concatenate([mat, layer_grads["bias"].reshape(-1, 1)], axis=1)
+    return mat
+
+
+def mat_to_grads(mat: jnp.ndarray, kernel_shape, has_bias: bool) -> Dict[str, Any]:
+    """Inverse of :func:`grads_to_mat` (kfac_preconditioner.py:303-308)."""
+    if has_bias:
+        weight_mat, bias_col = mat[:, :-1], mat[:, -1]
+    else:
+        weight_mat, bias_col = mat, None
+    if len(kernel_shape) == 4:
+        kernel = mat_to_conv_kernel(weight_mat, kernel_shape)
+    else:
+        kernel = mat_to_dense_kernel(weight_mat, kernel_shape)
+    out = {"kernel": kernel}
+    if bias_col is not None:
+        out["bias"] = bias_col
+    return out

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-device collective/sharding paths (pmean/psum/shard_map) are exercised on
+fake CPU devices — real SPMD semantics, no TPU pod needed (SURVEY.md §4).
+
+Note: this image's sitecustomize imports jax and registers the remote-TPU
+("axon") backend at interpreter startup, so env vars alone are too late —
+we must override the already-set ``jax_platforms`` config. Backends are
+instantiated lazily, so setting XLA_FLAGS here (before first device use)
+still yields 8 virtual CPU devices.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,98 @@
+"""Tests for eigendecomposition kernels and block partitioning.
+
+Block-partition cases mirror the reference's only unit test
+(kfac/tests/block_divide.py — which is stale there; live here).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kfac_pytorch_tpu.ops import eigh as eigh_ops
+
+
+def _rand_spd(n, seed=0):
+    rng = np.random.RandomState(seed)
+    m = rng.randn(n, n).astype(np.float32)
+    return m @ m.T / n + 0.1 * np.eye(n, dtype=np.float32)
+
+
+def test_eigh_reconstructs():
+    a = _rand_spd(16)
+    q, d = eigh_ops.eigh_with_floor(jnp.asarray(a))
+    rec = np.asarray(q) @ np.diag(np.asarray(d)) @ np.asarray(q).T
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+def test_eigh_floor_zeroes_tiny_eigenvalues():
+    # rank-deficient matrix: zero eigenvalues must be floored to exactly 0
+    v = np.ones((4, 1), np.float32)
+    a = (v @ v.T).astype(np.float32)
+    q, d = eigh_ops.eigh_with_floor(jnp.asarray(a), eps=1e-6)
+    d = np.asarray(d)
+    assert (d[np.abs(d) < 1e-6] == 0.0).all()
+    assert np.isclose(d.max(), 4.0, atol=1e-5)
+
+
+def test_block_boundary_full_matrix():
+    start, end = eigh_ops.get_block_boundary(0, 1, (10, 10))
+    assert start == [0, 0] and end == [10, 10]
+
+
+def test_block_boundary_even_split():
+    assert eigh_ops.get_block_boundary(0, 2, (10, 10)) == ([0, 0], [5, 5])
+    assert eigh_ops.get_block_boundary(1, 2, (10, 10)) == ([5, 5], [10, 10])
+
+
+def test_block_boundary_remainder_last_block():
+    # 10 / 3 -> blocks of 3, last absorbs remainder to 10
+    assert eigh_ops.get_block_boundary(2, 3, (10, 10)) == ([6, 6], [10, 10])
+
+
+def test_block_boundary_one_by_one():
+    assert eigh_ops.get_block_boundary(0, 1, (1, 1)) == ([0, 0], [1, 1])
+
+
+def test_block_boundary_non_square():
+    assert eigh_ops.get_block_boundary(0, 2, (10, 20)) == ([0, 0], [5, 10])
+    assert eigh_ops.get_block_boundary(1, 2, (10, 20)) == ([5, 10], [10, 20])
+
+
+def test_block_boundary_index_error():
+    with pytest.raises(ValueError):
+        eigh_ops.get_block_boundary(2, 2, (10, 10))
+
+
+def test_block_boundary_count_error():
+    with pytest.raises(ValueError):
+        eigh_ops.get_block_boundary(0, 11, (10, 10))
+
+
+def test_blocked_eigh_one_block_is_full_eigh():
+    a = _rand_spd(12, seed=1)
+    q1, d1 = eigh_ops.blocked_eigh(jnp.asarray(a), 1)
+    q2, d2 = eigh_ops.eigh_with_floor(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(q1), np.asarray(q2), atol=1e-5)
+
+
+def test_blocked_eigh_block_diagonal_structure():
+    a = _rand_spd(10, seed=2)
+    q, d = eigh_ops.blocked_eigh(jnp.asarray(a), 2)
+    q = np.asarray(q)
+    # off-diagonal blocks of Q are exactly zero
+    assert np.all(q[:5, 5:] == 0.0) and np.all(q[5:, :5] == 0.0)
+    # each diagonal block reconstructs its sub-factor
+    rec = q @ np.diag(np.asarray(d)) @ q.T
+    np.testing.assert_allclose(rec[:5, :5], a[:5, :5], atol=1e-4)
+    np.testing.assert_allclose(rec[5:, 5:], a[5:, 5:], atol=1e-4)
+
+
+def test_blocked_eigh_exact_on_block_diagonal_input():
+    # if the factor IS block diagonal, blocked eigh is exact
+    a = np.zeros((8, 8), np.float32)
+    a[:4, :4] = _rand_spd(4, seed=3)
+    a[4:, 4:] = _rand_spd(4, seed=4)
+    q, d = eigh_ops.blocked_eigh(jnp.asarray(a), 2)
+    rec = np.asarray(q) @ np.diag(np.asarray(d)) @ np.asarray(q).T
+    np.testing.assert_allclose(rec, a, atol=1e-4)

@@ -1,0 +1,152 @@
+"""Unit tests for factor math vs independent numpy references.
+
+Expected values are computed with plain numpy einsum implementations of the
+K-FAC factor definitions (SURVEY.md §2.1), independent of the library code.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from kfac_pytorch_tpu.ops import factors
+
+
+def _np_patches(x, kh, kw, sh, sw, ph, pw):
+    """Naive im2col, NHWC, channel-major (c, kh, kw) feature order."""
+    b, h, w, c = x.shape
+    xp = np.zeros((b, h + 2 * ph, w + 2 * pw, c), dtype=x.dtype)
+    xp[:, ph : ph + h, pw : pw + w, :] = x
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    out = np.zeros((b, oh, ow, c * kh * kw), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * sh : i * sh + kh, j * sw : j * sw + kw, :]
+            # (b, kh, kw, c) -> channel-major (c, kh, kw)
+            out[:, i, j, :] = patch.transpose(0, 3, 1, 2).reshape(b, -1)
+    return out
+
+
+def test_extract_patches_matches_naive():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    got = np.asarray(factors.extract_patches(jnp.asarray(x), (3, 3), (2, 2), ((1, 1), (1, 1))))
+    want = _np_patches(x, 3, 3, 2, 2, 1, 1)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_extract_patches_same_padding_string():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 5, 4).astype(np.float32)
+    got = factors.extract_patches(jnp.asarray(x), (3, 3), (1, 1), "SAME")
+    assert got.shape == (2, 5, 5, 4 * 9)
+
+
+def test_compute_a_dense_no_bias():
+    rng = np.random.RandomState(2)
+    a = rng.randn(16, 5).astype(np.float32)
+    got = np.asarray(factors.compute_a_dense(jnp.asarray(a), has_bias=False))
+    want = a.T @ (a / 16)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_compute_a_dense_bias_homogeneous_column():
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 5).astype(np.float32)
+    got = np.asarray(factors.compute_a_dense(jnp.asarray(a), has_bias=True))
+    ah = np.concatenate([a, np.ones((8, 1), np.float32)], 1)
+    want = ah.T @ (ah / 8)
+    assert got.shape == (6, 6)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # bias-bias entry is exactly 1 (mean of ones squared)
+    np.testing.assert_allclose(got[-1, -1], 1.0, atol=1e-6)
+
+
+def test_compute_a_dense_flattens_time_axis():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4, 7, 5).astype(np.float32)  # [B, T, d] (RNN LM decoder)
+    got = np.asarray(factors.compute_a_dense(jnp.asarray(a), has_bias=False))
+    a2 = a.reshape(28, 5)
+    want = a2.T @ (a2 / 28)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_compute_a_conv():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 6, 6, 2).astype(np.float32)
+    got = np.asarray(
+        factors.compute_a_conv(
+            jnp.asarray(x), (3, 3), (1, 1), ((1, 1), (1, 1)), has_bias=True
+        )
+    )
+    p = _np_patches(x, 3, 3, 1, 1, 1, 1)  # [3, 6, 6, 18]
+    spatial = 36
+    p2 = p.reshape(-1, 18)
+    p2 = np.concatenate([p2, np.ones((p2.shape[0], 1), np.float32)], 1)
+    p2 = p2 / spatial
+    want = p2.T @ (p2 / 3)
+    assert got.shape == (19, 19)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_compute_g_dense_batch_averaged():
+    rng = np.random.RandomState(6)
+    g = rng.randn(16, 9).astype(np.float32)
+    got = np.asarray(factors.compute_g_dense(jnp.asarray(g), batch_averaged=True))
+    want = g.T @ (g * 16)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    got2 = np.asarray(factors.compute_g_dense(jnp.asarray(g), batch_averaged=False))
+    want2 = g.T @ (g / 16)
+    np.testing.assert_allclose(got2, want2, atol=1e-5)
+
+
+def test_compute_g_conv():
+    rng = np.random.RandomState(7)
+    g = rng.randn(4, 5, 5, 6).astype(np.float32)  # NHWC output grads
+    got = np.asarray(factors.compute_g_conv(jnp.asarray(g), batch_averaged=True))
+    spatial = 25
+    g2 = g.reshape(-1, 6) * 4 * spatial
+    want = g2.T @ (g2 / (4 * spatial))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+
+
+def test_update_running_avg_code_semantics():
+    # alpha weights HISTORY (reference code, not its docstring).
+    cur = jnp.ones((3, 3))
+    new = jnp.zeros((3, 3))
+    out = factors.update_running_avg(new, cur, alpha=0.95)
+    np.testing.assert_allclose(np.asarray(out), 0.95 * np.ones((3, 3)), atol=1e-7)
+
+
+def test_conv_kernel_mat_roundtrip_and_patch_consistency():
+    rng = np.random.RandomState(8)
+    k = rng.randn(3, 3, 2, 4).astype(np.float32)  # HWIO
+    mat = factors.conv_kernel_to_mat(jnp.asarray(k))
+    assert mat.shape == (4, 18)
+    back = factors.mat_to_conv_kernel(mat, k.shape)
+    np.testing.assert_allclose(np.asarray(back), k, atol=1e-7)
+    # conv(x, k) == patches(x) @ mat.T  — proves A's index space matches grads
+    x = rng.randn(2, 5, 5, 2).astype(np.float32)
+    y_conv = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(k), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    p = factors.extract_patches(jnp.asarray(x), (3, 3), (1, 1), ((1, 1), (1, 1)))
+    np.testing.assert_allclose(np.asarray(p @ mat.T), np.asarray(y_conv), atol=1e-4)
+
+
+def test_grads_mat_roundtrip_dense_and_conv():
+    rng = np.random.RandomState(9)
+    gd = {"kernel": jnp.asarray(rng.randn(5, 7).astype(np.float32)),
+          "bias": jnp.asarray(rng.randn(7).astype(np.float32))}
+    mat = factors.grads_to_mat(gd)
+    assert mat.shape == (7, 6)
+    back = factors.mat_to_grads(mat, (5, 7), has_bias=True)
+    np.testing.assert_allclose(np.asarray(back["kernel"]), np.asarray(gd["kernel"]), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(back["bias"]), np.asarray(gd["bias"]), atol=1e-7)
+
+    gc = {"kernel": jnp.asarray(rng.randn(3, 3, 2, 4).astype(np.float32))}
+    matc = factors.grads_to_mat(gc)
+    assert matc.shape == (4, 18)
+    backc = factors.mat_to_grads(matc, (3, 3, 2, 4), has_bias=False)
+    np.testing.assert_allclose(np.asarray(backc["kernel"]), np.asarray(gc["kernel"]), atol=1e-7)
