@@ -1,0 +1,130 @@
+"""Benchmark: ResNet-50 K-FAC step-time overhead vs plain SGD on real TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline target (BASELINE.md): amortized K-FAC step overhead < 25% vs
+SGD at the reference's ImageNet schedule (kfac-update-freq 100, cov-update
+-freq 10, sbatch/longhorn/imagenet_kfac.slurm:30-38). We measure the three
+step variants (plain/preconditioned, +factor update, +eigen update) and
+amortize by their schedule frequencies; ``vs_baseline`` is overhead/25 (<1 is
+better than target). Extra detail goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+if os.environ.get("KFAC_FORCE_PLATFORM"):  # testing escape hatch (examples/_env.py)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+    import _env  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(step, state, warmup=2, iters=8):
+    """Time a state-threading step (the step donates and returns state)."""
+    for _ in range(warmup):
+        state = step(state)
+        jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(state)
+        jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters, state
+
+
+def main():
+    from kfac_pytorch_tpu import KFAC
+    from kfac_pytorch_tpu.models import imagenet_resnet
+    from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+    batch = int(sys.argv[sys.argv.index("--batch") + 1]) if "--batch" in sys.argv else 32
+    size = int(sys.argv[sys.argv.index("--image-size") + 1]) if "--image-size" in sys.argv else 224
+    fac_freq, kfac_freq = 10, 100  # reference ImageNet schedule
+
+    model = imagenet_resnet.get_model("resnet50")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=batch).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros_like(images), train=True)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+
+    tx = make_sgd(momentum=0.9, weight_decay=5e-5)
+
+    def fresh_state(kfac):
+        # deep-copy: train steps donate their input state, so each benchmark
+        # arm needs its own buffers
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        bs = jax.tree_util.tree_map(jnp.copy, batch_stats)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=p,
+            batch_stats=bs,
+            opt_state=tx.init(p),
+            kfac_state=kfac.init(p) if kfac else None,
+        )
+
+    lr, damping = jnp.float32(0.1), jnp.float32(0.001)
+
+    # SGD baseline
+    sgd_step = make_train_step(model, tx, None, train_kwargs={"train": True})
+
+    def run_sgd(state):
+        s, _ = sgd_step(state, (images, labels), lr, damping)
+        return s
+
+    kfac = KFAC(damping=0.001, fac_update_freq=fac_freq, kfac_update_freq=kfac_freq)
+    kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+
+    def run_kfac(uf, ue):
+        def _step(state):
+            s, _ = kfac_step(state, (images, labels), lr, damping,
+                             update_factors=uf, update_eigen=ue)
+            return s
+        return _step
+
+    t_sgd, _ = _timeit(run_sgd, fresh_state(None))
+    print(f"sgd step: {t_sgd*1e3:.1f} ms ({batch/t_sgd:.1f} img/s)", file=sys.stderr)
+
+    # populate eigen state once so the plain variant preconditions real factors
+    s_kfac = run_kfac(True, True)(fresh_state(kfac))
+    t_plain, s_kfac = _timeit(run_kfac(False, False), s_kfac)
+    t_fac, s_kfac = _timeit(run_kfac(True, False), s_kfac)
+    t_full, s_kfac = _timeit(run_kfac(True, True), s_kfac, warmup=1, iters=3)
+    print(
+        f"kfac steps: precond-only {t_plain*1e3:.1f} ms, +factors "
+        f"{t_fac*1e3:.1f} ms, +eigen {t_full*1e3:.1f} ms",
+        file=sys.stderr,
+    )
+
+    f_full = 1.0 / kfac_freq
+    f_fac = 1.0 / fac_freq - f_full
+    f_plain = 1.0 - f_fac - f_full
+    t_amort = f_plain * t_plain + f_fac * t_fac + f_full * t_full
+    overhead_pct = (t_amort - t_sgd) / t_sgd * 100.0
+    print(
+        f"amortized kfac step: {t_amort*1e3:.1f} ms → overhead "
+        f"{overhead_pct:.1f}% (target <25%)",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_kfac_step_overhead_vs_sgd",
+                "value": round(overhead_pct, 2),
+                "unit": "percent",
+                "vs_baseline": round(overhead_pct / 25.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

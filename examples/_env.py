@@ -1,0 +1,22 @@
+"""Example-CLI environment helper.
+
+``KFAC_FORCE_PLATFORM=cpu[:N]`` forces the JAX platform (optionally with N
+virtual host devices) — needed on images whose sitecustomize pre-imports jax
+and pins a remote TPU backend, where ``JAX_PLATFORMS`` alone is ignored.
+Import this FIRST in every example CLI.
+"""
+
+import os
+
+_force = os.environ.get("KFAC_FORCE_PLATFORM")
+if _force:
+    plat, _, n = _force.partition(":")
+    if n:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", plat)

@@ -8,11 +8,24 @@ natural-gradient preconditioning — expressed as pure functions over explicit
 state, sharded with ``jax.sharding.Mesh`` + ``shard_map``, and compiled as a
 single XLA program per train step.
 
-Target public API (parity with ``from kfac import KFAC, KFACParamScheduler``,
-reference kfac/__init__.py:1-2) — re-exported here once the preconditioner
-module lands:
+Public API (parity with ``from kfac import KFAC, KFACParamScheduler``,
+reference kfac/__init__.py:1-2):
 
     from kfac_pytorch_tpu import KFAC, KFACParamScheduler
 """
 
+from kfac_pytorch_tpu import capture, ops
+from kfac_pytorch_tpu.preconditioner import KFAC, KFACHParams, KFACState
+from kfac_pytorch_tpu.scheduler import KFACParamScheduler
+
 __version__ = "0.1.0"
+
+__all__ = [
+    "KFAC",
+    "KFACHParams",
+    "KFACState",
+    "KFACParamScheduler",
+    "capture",
+    "ops",
+    "__version__",
+]

@@ -1,0 +1,179 @@
+"""Extract per-layer K-FAC statistics from flax variable/grad pytrees.
+
+The functional replacement for the reference's hook-state dictionaries
+(``m_a``/``m_g`` keyed by module object, kfac_preconditioner.py:109-114):
+layers are keyed by their '/'-joined module path, and all artifacts for one
+layer — kernel/bias grads in ``params``, the A-factor contribution in
+``kfac_acts``, the output-gradient in the ``perturbations`` cotangent — share
+that key by construction (see models/layers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu.models.layers import A_CONTRIB, OUT_PERTURB
+from kfac_pytorch_tpu.ops import factors
+
+PyTree = Any
+
+
+def _flatten_with_paths(tree: PyTree) -> List[Tuple[Tuple[str, ...], Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in flat:
+        keys = tuple(
+            p.key if isinstance(p, jax.tree_util.DictKey) else str(p) for p in path
+        )
+        out.append((keys, leaf))
+    return out
+
+
+def layer_names(params: PyTree) -> List[str]:
+    """Heuristic K-FAC layer list: module paths with rank-2/4 ``kernel`` leaves.
+
+    Mirrors the reference's ``known_modules = {'Linear', 'Conv2d'}`` scan
+    (kfac_preconditioner.py:103). Correct when every rank-2/4 ``kernel`` in
+    the model belongs to a capture-aware KFACDense/KFACConv; models mixing in
+    other kernel-bearing modules (e.g. grouped convs, plain nn.Dense) must
+    use :func:`discover_layers` and pass the result to ``KFAC(layers=...)``.
+    Order is the sorted flattened-path order — deterministic across
+    processes, as the layer→device assignment requires.
+    """
+    names = []
+    for keys, leaf in _flatten_with_paths(params):
+        if keys[-1] == "kernel" and leaf.ndim in (2, 4):
+            names.append("/".join(keys[:-1]))
+    return names
+
+
+def layer_names_from_capture(captured: PyTree) -> List[str]:
+    """Authoritative layer list: paths that sowed an A contribution."""
+    names = []
+    for keys, _ in _flatten_with_paths(captured):
+        if keys[-1] == A_CONTRIB or (
+            len(keys) >= 2 and keys[-2] == A_CONTRIB
+        ):  # sow may wrap the leaf in a tuple (path gains an index key)
+            name = "/".join(keys[: -1 if keys[-1] == A_CONTRIB else -2])
+            if name not in names:
+                names.append(name)
+    return names
+
+
+def discover_layers(model, *args, **kwargs) -> List[str]:
+    """K-FAC layer names for ``model``, via an abstract (FLOP-free) init.
+
+    The authoritative discovery: a layer is preconditionable iff it sows into
+    the ``kfac_acts`` collection. Pass the same example args as ``init``.
+    """
+    from kfac_pytorch_tpu.models.layers import KFAC_ACTS
+
+    shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs))
+    return layer_names_from_capture(shapes.get(KFAC_ACTS, {}))
+
+
+def _get_path(tree: PyTree, name: str) -> Any:
+    node = tree
+    for k in name.split("/"):
+        node = node[k]
+    return node
+
+
+def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Pull ``{'kernel': ..., 'bias'?: ...}`` grad dicts for each K-FAC layer."""
+    out = {}
+    for name in names:
+        node = _get_path(grads, name)
+        entry = {"kernel": node["kernel"]}
+        if "bias" in node:
+            entry["bias"] = node["bias"]
+        out[name] = entry
+    return out
+
+
+def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
+    """Pull per-layer A-factor contributions from the ``kfac_acts`` collection."""
+    out = {}
+    for name in names:
+        leaf = _get_path(captured, name)[A_CONTRIB]
+        # sow reduce_fn=overwrite still wraps the value in a 1-tuple.
+        if isinstance(leaf, tuple):
+            leaf = leaf[-1]
+        out[name] = leaf
+    return out
+
+
+def g_factors(
+    perturb_grads: PyTree, names: List[str], batch_averaged: bool
+) -> Dict[str, jnp.ndarray]:
+    """G factors from ∂L/∂(layer output) cotangents.
+
+    Rank dispatch replaces the reference's isinstance dispatch
+    (kfac/utils.py:144-153): rank-4 cotangents are conv outputs (NHWC),
+    rank-2/3 are dense outputs (possibly with a time axis).
+    """
+    out = {}
+    for name in names:
+        g = _get_path(perturb_grads, name)[OUT_PERTURB]
+        if g.ndim == 4:
+            out[name] = factors.compute_g_conv(
+                g.astype(jnp.float32), batch_averaged=batch_averaged
+            )
+        else:
+            out[name] = factors.compute_g_dense(
+                g.astype(jnp.float32), batch_averaged=batch_averaged
+            )
+    return out
+
+
+def grad_mats(
+    lgrads: Dict[str, Dict[str, jnp.ndarray]]
+) -> Dict[str, jnp.ndarray]:
+    """Per-layer factor-space gradient matrices ``[out, in(+1)]``."""
+    return {name: factors.grads_to_mat(g) for name, g in lgrads.items()}
+
+
+def write_back(
+    grads: PyTree, updates: Dict[str, jnp.ndarray], nu: jnp.ndarray
+) -> PyTree:
+    """Scatter ν-scaled preconditioned matrices back into the full grad pytree.
+
+    Non-K-FAC leaves (BN, embeddings, ...) pass through untouched — parity
+    with the reference, which only rewrites Linear/Conv2d grads
+    (kfac_preconditioner.py:328-334).
+    """
+    def _deep_copy(node):
+        if isinstance(node, dict):
+            return {k: _deep_copy(v) for k, v in node.items()}
+        return node
+
+    grads = _deep_copy(grads)
+    for name, mat in updates.items():
+        node = _get_path(grads, name)
+        kernel_shape = node["kernel"].shape
+        new = factors.mat_to_grads(
+            mat * nu, kernel_shape, has_bias="bias" in node
+        )
+        node["kernel"] = new["kernel"].astype(node["kernel"].dtype)
+        if "bias" in node:
+            node["bias"] = new["bias"].astype(node["bias"].dtype)
+    return grads
+
+
+def perturbation_zeros(model, *args, **kwargs) -> PyTree:
+    """Zero perturbation pytree matching the model's layer outputs for a batch.
+
+    Shapes depend on the batch, so this is evaluated per batch-shape via
+    ``jax.eval_shape`` (no FLOPs); apply args/kwargs are passed through
+    (e.g. ``train=True``).
+    """
+    from kfac_pytorch_tpu.models.layers import PERTURBATIONS
+
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs)
+    )
+    perts = shapes[PERTURBATIONS]
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), perts)

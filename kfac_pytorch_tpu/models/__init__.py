@@ -1,0 +1,10 @@
+"""Model zoo (flax): K-FAC-aware layers + CIFAR/ImageNet ResNets + RNN LM.
+
+Capability parity with the reference zoos (examples/cifar_resnet.py,
+examples/imagenet_resnet.py, examples/wikitext_models.py), built TPU-first on
+NHWC layouts and the capture-aware layers in ``layers.py``.
+"""
+
+from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense
+
+__all__ = ["KFACConv", "KFACDense"]

@@ -1,0 +1,134 @@
+"""CIFAR ResNets (20/32/44/56/110/1202) with option-A shortcuts, flax/NHWC.
+
+Capability parity with the reference zoo (examples/cifar_resnet.py): proper
+ResNets for CIFAR-10 per He et al. — 3×3 stem, three stages of widths
+16/32/64 with n blocks each (depth = 6n+2), identity ("option A") shortcuts
+realized as stride-2 subsampling + zero channel padding, kaiming-normal init,
+convs without bias (examples/cifar_resnet.py:59-61), final dense classifier
+with bias (the only layer whose A-factor gains a homogeneous bias column).
+
+Convs/dense are the K-FAC capture-aware layers from ``layers.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense
+
+_kaiming = nn.initializers.he_normal()
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs + BN with an option-A (parameter-free) shortcut."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        y = KFACConv(
+            self.planes,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding=((1, 1), (1, 1)),
+            use_bias=False,
+            kernel_init=_kaiming,
+            dtype=self.dtype,
+        )(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = KFACConv(
+            self.planes,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            use_bias=False,
+            kernel_init=_kaiming,
+            dtype=self.dtype,
+        )(y)
+        y = norm()(y)
+
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            # Option A (examples/cifar_resnet.py:81-87): spatial 2× subsample
+            # + zero-pad channels; adds no parameters, so K-FAC sees only the
+            # convs.
+            sc = x[:, :: self.stride, :: self.stride, :]
+            pad = self.planes - x.shape[-1]
+            sc = jnp.pad(sc, ((0, 0), (0, 0), (0, 0), (pad // 2, pad - pad // 2)))
+        else:
+            sc = x
+        return nn.relu(y + sc)
+
+
+class CifarResNet(nn.Module):
+    """Stem + 3 stages + global-avg-pool + dense head."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = KFACConv(
+            16,
+            (3, 3),
+            padding=((1, 1), (1, 1)),
+            use_bias=False,
+            kernel_init=_kaiming,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype,
+        )(x)
+        x = nn.relu(x)
+        for stage, (planes, blocks) in enumerate(zip((16, 32, 64), self.stage_sizes)):
+            for i in range(blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = BasicBlock(planes, stride, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = KFACDense(self.num_classes, use_bias=True, kernel_init=_kaiming)(
+            x.astype(jnp.float32)
+        )
+        return x
+
+
+def _factory(n: int):
+    return partial(CifarResNet, stage_sizes=(n, n, n))
+
+
+# depth = 6n + 2 (examples/cifar_resnet.py:110-135)
+resnet20 = _factory(3)
+resnet32 = _factory(5)
+resnet44 = _factory(7)
+resnet56 = _factory(9)
+resnet110 = _factory(18)
+resnet1202 = _factory(200)
+
+_MODELS = {
+    "resnet20": resnet20,
+    "resnet32": resnet32,
+    "resnet44": resnet44,
+    "resnet56": resnet56,
+    "resnet110": resnet110,
+    "resnet1202": resnet1202,
+}
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    """Factory by name (the CLI's ``--model`` flag)."""
+    if name not in _MODELS:
+        raise ValueError(f"unknown cifar model {name!r}; options: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)

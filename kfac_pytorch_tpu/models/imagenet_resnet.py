@@ -1,0 +1,202 @@
+"""ImageNet ResNets: v1.5 ResNet-18/34/50/101/152, ResNeXt, WideResNet (flax).
+
+Capability parity with the reference zoo (examples/imagenet_resnet.py, a
+torchvision-0.5 copy): BasicBlock/Bottleneck ResNet v1.5 (stride on the 3×3
+in the bottleneck — examples/imagenet_resnet.py docstring), grouped-conv
+ResNeXt variants, wide variants, zero-init of the last BN gamma per block
+(``zero_init_residual``-style torchvision default is False there; we keep
+False for parity), no pretrained weights (the reference raises on
+``pretrained=True``, examples/imagenet_resnet.py:235).
+
+K-FAC capture: all non-grouped convs and the final dense head are
+capture-aware. Grouped convs (ResNeXt) are intentionally *not* preconditioned
+— the reference's factor math is shape-inconsistent for ``groups > 1`` (its
+``ComputeA`` builds an ``in·kh·kw`` factor against an ``in/groups·kh·kw``
+grad matrix, kfac/utils.py:108-117 vs kfac_preconditioner.py:279-281, which
+would crash); we instead train them with plain SGD like BN params, which is
+well-defined and lets ResNeXt actually run under K-FAC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense
+
+_kaiming = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class _GroupedConv(nn.Module):
+    """Plain grouped conv (NOT K-FAC captured — see module docstring)."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int]
+    padding: Any
+    groups: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            _kaiming,
+            (kh, kw, x.shape[-1] // self.groups, self.features),
+            jnp.float32,
+        )
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        return lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=self.padding,
+            feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+def _conv(features, kernel_size, strides=(1, 1), padding=((0, 0), (0, 0)),
+          groups=1, dtype=None, name=None):
+    if groups == 1:
+        return KFACConv(
+            features, kernel_size, strides=strides, padding=padding,
+            use_bias=False, kernel_init=_kaiming, dtype=dtype, name=name,
+        )
+    return _GroupedConv(
+        features, kernel_size, strides, padding, groups, dtype=dtype, name=name
+    )
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    base_width: int = 64
+    groups: int = 1
+    dtype: Any = None
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        y = _conv(self.planes, (3, 3), (self.stride, self.stride),
+                  ((1, 1), (1, 1)), dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = _conv(self.planes, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(y)
+        y = norm()(y)
+        if self.downsample:
+            x = _conv(self.planes * self.expansion, (1, 1),
+                      (self.stride, self.stride), dtype=self.dtype)(x)
+            x = norm()(x)
+        return nn.relu(y + x)
+
+
+class Bottleneck(nn.Module):
+    """1×1 → 3×3 (stride, groups) → 1×1·4; v1.5 puts the stride on the 3×3."""
+
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    base_width: int = 64
+    groups: int = 1
+    dtype: Any = None
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        width = int(self.planes * (self.base_width / 64.0)) * self.groups
+        y = _conv(width, (1, 1), dtype=self.dtype)(x)
+        y = nn.relu(norm()(y))
+        y = _conv(width, (3, 3), (self.stride, self.stride), ((1, 1), (1, 1)),
+                  groups=self.groups, dtype=self.dtype)(y)
+        y = nn.relu(norm()(y))
+        y = _conv(self.planes * self.expansion, (1, 1), dtype=self.dtype)(y)
+        y = norm()(y)
+        if self.downsample:
+            x = _conv(self.planes * self.expansion, (1, 1),
+                      (self.stride, self.stride), dtype=self.dtype)(x)
+            x = norm()(x)
+        return nn.relu(y + x)
+
+
+class ImageNetResNet(nn.Module):
+    block: Callable
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    groups: int = 1
+    width_per_group: int = 64
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = KFACConv(64, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                     use_bias=False, kernel_init=_kaiming, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        expansion = self.block.inner.expansion if hasattr(self.block, "inner") else (
+            4 if self.block is Bottleneck else 1)
+        in_planes = 64
+        for stage, blocks in enumerate(self.stage_sizes):
+            planes = 64 * (2**stage)
+            for i in range(blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                downsample = stride != 1 or in_planes != planes * expansion
+                x = self.block(
+                    planes,
+                    stride=stride,
+                    downsample=downsample,
+                    base_width=self.width_per_group,
+                    groups=self.groups,
+                    dtype=self.dtype,
+                )(x, train)
+                in_planes = planes * expansion
+        x = jnp.mean(x, axis=(1, 2))
+        return KFACDense(self.num_classes, use_bias=True)(x.astype(jnp.float32))
+
+
+def _make(block, sizes, **kw):
+    return partial(ImageNetResNet, block=block, stage_sizes=sizes, **kw)
+
+
+resnet18 = _make(BasicBlock, (2, 2, 2, 2))
+resnet34 = _make(BasicBlock, (3, 4, 6, 3))
+resnet50 = _make(Bottleneck, (3, 4, 6, 3))
+resnet101 = _make(Bottleneck, (3, 4, 23, 3))
+resnet152 = _make(Bottleneck, (3, 8, 36, 3))
+resnext50_32x4d = _make(Bottleneck, (3, 4, 6, 3), groups=32, width_per_group=4)
+resnext101_32x8d = _make(Bottleneck, (3, 4, 23, 3), groups=32, width_per_group=8)
+wide_resnet50_2 = _make(Bottleneck, (3, 4, 6, 3), width_per_group=128)
+wide_resnet101_2 = _make(Bottleneck, (3, 4, 23, 3), width_per_group=128)
+
+_MODELS = {
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "resnet152": resnet152,
+    "resnext50_32x4d": resnext50_32x4d,
+    "resnext101_32x8d": resnext101_32x8d,
+    "wide_resnet50_2": wide_resnet50_2,
+    "wide_resnet101_2": wide_resnet101_2,
+}
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    """Factory by name, mirroring the reference's ``--model`` choices."""
+    if name not in _MODELS:
+        raise ValueError(
+            f"unknown imagenet model {name!r}; options: {sorted(_MODELS)}"
+        )
+    return _MODELS[name](**kwargs)

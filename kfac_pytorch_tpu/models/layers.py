@@ -1,0 +1,186 @@
+"""K-FAC-aware flax layers: Dense/Conv with curvature-statistics capture.
+
+This replaces the reference's torch hook machinery
+(``register_forward_pre_hook`` / ``register_backward_hook``,
+kfac_preconditioner.py:146-153) — JAX has no module hooks, so capture is
+explicit and functional:
+
+* **A-side (input covariance):** each layer computes its own A-factor
+  *contribution* from its input and ``sow``s it into the ``kfac_acts``
+  collection. Sowing the [d, d] contribution instead of raw activations keeps
+  capture memory O(d²) per layer instead of O(batch·d), and keeps the
+  patch-extraction config (stride/padding/dilation) local to the layer — the
+  optimizer never needs layer metadata. When ``kfac_acts`` is not listed as
+  mutable in ``Module.apply``, the contribution is neither computed nor
+  stored (capture is free on non-update steps).
+
+* **G-side (grad-output covariance):** each layer adds a zero "perturbation"
+  variable to its output (flax's ``Module.perturb``); differentiating the
+  loss w.r.t. the ``perturbations`` collection yields exactly ∂L/∂(layer
+  output). This is *cleaner* than the reference's deprecated
+  ``register_backward_hook`` (which fires on pre-accumulation module grads);
+  JAX gives the true output gradient.
+
+Because both collections live at the same module path as the layer's params,
+every per-layer artifact (kernel/bias grads, A contribution, output grad)
+aligns on one path key — see ``capture.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from kfac_pytorch_tpu.ops import factors
+
+Dtype = Any
+Padding = Union[str, int, Sequence[Tuple[int, int]]]
+
+# Collection names (public constants — capture.py and train steps use them).
+KFAC_ACTS = "kfac_acts"
+PERTURBATIONS = "perturbations"
+# Variable names inside a layer's path.
+A_CONTRIB = "a"
+OUT_PERTURB = "out"
+
+
+def _overwrite(old: Any, new: Any) -> Any:
+    """sow reduce_fn: keep only the latest value (no tuple accumulation)."""
+    del old
+    return new
+
+
+def _normalize_padding(padding: Padding) -> Union[str, Tuple[Tuple[int, int], ...]]:
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    out = []
+    for p in padding:
+        out.append((p, p) if isinstance(p, int) else tuple(p))
+    return tuple(out)
+
+
+class _KFACLayer(nn.Module):
+    """Shared capture plumbing for K-FAC-aware layers."""
+
+    def _capturing(self) -> bool:
+        return self.is_initializing() or self.is_mutable_collection(KFAC_ACTS)
+
+    def _sow_a(self, contrib_fn: Callable[[], jnp.ndarray]) -> None:
+        # Only trace the (expensive) factor contribution when capturing; on
+        # plain steps the matmul never enters the program.
+        if self._capturing():
+            self.sow(KFAC_ACTS, A_CONTRIB, contrib_fn(), reduce_fn=_overwrite)
+
+    def _maybe_perturb(self, y: jnp.ndarray) -> jnp.ndarray:
+        # Gate so the model also applies cleanly WITHOUT a perturbations
+        # collection (eval / plain SGD steps): flax's Module.perturb would
+        # require the collection to exist.
+        if self.is_initializing() or self.has_variable(PERTURBATIONS, OUT_PERTURB):
+            return self.perturb(OUT_PERTURB, y)
+        return y
+
+
+class KFACDense(_KFACLayer):
+    """Dense layer (``y = x @ kernel + bias``) with K-FAC capture.
+
+    Drop-in for ``flax.linen.Dense``; the preconditionable analog of the
+    reference's ``nn.Linear`` handling (kfac/utils.py:119-128, 172-183).
+    Inputs of rank > 2 (e.g. ``[B, T, d]``) are supported — factor math
+    flattens leading axes, matching how the reference's LM decoder flattens
+    tokens.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
+        )
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+        else:
+            bias = None
+
+        self._sow_a(
+            lambda: factors.compute_a_dense(
+                x.astype(jnp.float32), has_bias=self.use_bias
+            )
+        )
+
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = jnp.matmul(x, kernel)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return self._maybe_perturb(y)
+
+
+class KFACConv(_KFACLayer):
+    """2-D convolution (NHWC/HWIO) with K-FAC capture.
+
+    Drop-in for ``flax.linen.Conv`` (2-D case); the preconditionable analog
+    of the reference's ``nn.Conv2d`` handling (kfac/utils.py:107-117,
+    155-170). The A-factor contribution runs the same patch extraction the
+    conv itself uses, so stride/padding/dilation stay consistent by
+    construction.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Padding = "SAME"
+    kernel_dilation: Tuple[int, int] = (1, 1)
+    use_bias: bool = False
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (kh, kw, x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+        else:
+            bias = None
+
+        padding = _normalize_padding(self.padding)
+        self._sow_a(
+            lambda: factors.compute_a_conv(
+                x.astype(jnp.float32),
+                self.kernel_size,
+                self.strides,
+                padding,
+                has_bias=self.use_bias,
+                kernel_dilation=self.kernel_dilation,
+            )
+        )
+
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=padding,
+            rhs_dilation=self.kernel_dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return self._maybe_perturb(y)

@@ -1,0 +1,98 @@
+"""Word-level RNN language model (LSTM/GRU/RNN_TANH/RNN_RELU), flax.
+
+Capability parity with the reference LM (examples/wikitext_models.py):
+Embedding → n recurrent layers (with inter-layer dropout) → dense decoder,
+optional weight tying. Differences, both deliberate:
+
+* The reference's WikiText trainer is marked "work-in-progress and does not
+  work with K-FAC yet" (pytorch_wikitext_rnn.py:6) and actually crashes when
+  K-FAC is enabled (stale kwargs, SURVEY.md §2.2). Here the decoder is a
+  capture-aware ``KFACDense`` so the LM genuinely trains under K-FAC (the
+  recurrent cells and embedding stay SGD-trained, matching the reference's
+  ``known_modules`` contract).
+* Returns logits (loss applies log_softmax), plus the final recurrent carry
+  for truncated-BPTT hidden-state repackaging (pytorch_wikitext_rnn.py:
+  224-229) — the caller ``lax.stop_gradient``s it between segments.
+
+With ``tie_weights=True`` the decoder shares the embedding matrix
+(``Embed.attend``) and is therefore not an independent K-FAC layer — tied
+runs train the decoder via the embedding's SGD gradient, which is
+well-defined (the reference would have preconditioned a doubly-used weight
+with single-use statistics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu.models.layers import KFACDense
+
+RNN_TYPES = ("LSTM", "GRU", "RNN_TANH", "RNN_RELU")
+
+
+def _make_cell(rnn_type: str, nhid: int):
+    if rnn_type == "LSTM":
+        return nn.OptimizedLSTMCell(nhid)
+    if rnn_type == "GRU":
+        return nn.GRUCell(nhid)
+    if rnn_type == "RNN_TANH":
+        return nn.SimpleCell(nhid, activation_fn=jnp.tanh)
+    if rnn_type == "RNN_RELU":
+        return nn.SimpleCell(nhid, activation_fn=nn.relu)
+    raise ValueError(f"unknown rnn_type {rnn_type!r}; options: {RNN_TYPES}")
+
+
+class RNNModel(nn.Module):
+    """Encoder–recurrent–decoder LM (examples/wikitext_models.py:1-72)."""
+
+    ntoken: int
+    ninp: int = 200
+    nhid: int = 200
+    nlayers: int = 2
+    rnn_type: str = "LSTM"
+    dropout: float = 0.5
+    tie_weights: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,  # [B, T] int
+        carry: Optional[List[Any]] = None,
+        train: bool = True,
+    ) -> Tuple[jnp.ndarray, List[Any]]:
+        if self.tie_weights and self.nhid != self.ninp:
+            raise ValueError("tie_weights requires nhid == ninp")
+        encoder = nn.Embed(self.ntoken, self.ninp, name="encoder")
+        x = encoder(tokens)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        new_carry = []
+        for i in range(self.nlayers):
+            rnn = nn.RNN(_make_cell(self.rnn_type, self.nhid), name=f"rnn_{i}")
+            init_c = carry[i] if carry is not None else None
+            c, x = rnn(x, initial_carry=init_c, return_carry=True)
+            new_carry.append(c)
+            if i < self.nlayers - 1:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        if self.tie_weights:
+            logits = encoder.attend(x)
+        else:
+            logits = KFACDense(self.ntoken, use_bias=True, name="decoder")(x)
+        return logits, new_carry
+
+
+def get_model(
+    rnn_type: str, ntoken: int, ninp: int, nhid: int, nlayers: int,
+    dropout: float = 0.5, tied: bool = False,
+) -> RNNModel:
+    """Factory mirroring the reference's ``RNNModel(...)`` signature."""
+    return RNNModel(
+        ntoken=ntoken, ninp=ninp, nhid=nhid, nlayers=nlayers,
+        rnn_type=rnn_type, dropout=dropout, tie_weights=tied,
+    )

@@ -1,0 +1,24 @@
+"""Distribution policy: device meshes, layer→device assignment, sharded eigh.
+
+TPU-native replacement for the reference's Horovod topology + round-robin
+work distribution (kfac_preconditioner.py:383-399, 410-437): assignment
+tables are computed host-side (static w.r.t. compilation), eigendecomposition
+work is sharded with ``jax.shard_map`` + ``lax.cond`` on ``axis_index``, and
+results are exchanged with a single ``psum`` of zero-masked buffers — the
+reference's "allgather via sum of zeros" trick (kfac_preconditioner.py:
+424-426) expressed as one XLA collective over ICI.
+"""
+
+from kfac_pytorch_tpu.parallel.assignment import (
+    RoundRobin,
+    layer_assignment,
+)
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.parallel.sharded_eigh import sharded_eigen_update
+
+__all__ = [
+    "RoundRobin",
+    "layer_assignment",
+    "data_parallel_mesh",
+    "sharded_eigen_update",
+]

@@ -1,0 +1,60 @@
+"""Deterministic layer→device work assignment for eigendecompositions.
+
+Host-side Python mirror of the reference's ``cycle`` iterator + per-update
+``reset()`` discipline (kfac/utils.py:12-39, kfac_preconditioner.py:383-396):
+because the table is recomputed from scratch for a given (world, layers,
+diag_blocks, distribute_layer_factors) tuple, every device derives the same
+map and each device keeps the same layers across updates (cache reuse). The
+table is static configuration, so it compiles into the XLA program rather
+than being communicated.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+
+class RoundRobin:
+    """Infinite cycle over ``range(world)`` yielding n-tuples.
+
+    Behavioral parity with ``kfac.utils.cycle`` (kfac/utils.py:12-39).
+    """
+
+    def __init__(self, world: int):
+        self.world = world
+        self.reset()
+
+    def reset(self) -> None:
+        self._it = itertools.cycle(range(self.world))
+
+    def next(self, size: int) -> Tuple[int, ...]:
+        return tuple(next(self._it) for _ in range(size))
+
+
+def layer_assignment(
+    names: List[str],
+    is_conv: Dict[str, bool],
+    world: int,
+    distribute_layer_factors: Optional[bool] = None,
+    diag_blocks: int = 1,
+) -> Dict[str, Dict[str, Tuple[int, ...]]]:
+    """Compute ``{layer: {'A': ranks, 'G': ranks}}`` ownership.
+
+    * ``distribute_layer_factors=None`` → auto rule: split A and G of the
+      same layer onto different devices iff ``world > len(names)``
+      (kfac_preconditioner.py:126-130).
+    * Conv layers get ``diag_blocks`` owner ranks (one per diagonal block);
+      dense layers always 1 (``_get_diag_blocks``, kfac_preconditioner.py:
+      257-268).
+    """
+    if distribute_layer_factors is None:
+        distribute_layer_factors = world > len(names)
+    rr = RoundRobin(world)
+    table: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    for name in names:
+        n = diag_blocks if is_conv[name] else 1
+        ranks_a = rr.next(n)
+        ranks_g = rr.next(n) if distribute_layer_factors else ranks_a
+        table[name] = {"A": ranks_a, "G": ranks_g}
+    return table

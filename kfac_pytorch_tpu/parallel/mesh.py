@@ -1,0 +1,28 @@
+"""Device-mesh construction helpers.
+
+The reference's process topology (``hvd.rank()/size()/local_rank()``) maps to
+``jax.sharding.Mesh`` axes + ``jax.process_index()`` here; collectives ride
+ICI within a slice and DCN across slices with XLA choosing the routing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def data_parallel_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis_name: str = "data"
+) -> Mesh:
+    """1-D data-parallel mesh over all (or the given) devices.
+
+    The reference's only forward/backward parallelism is DP (SURVEY.md §2.4);
+    eigendecomposition work-sharding rides the same axis, exactly as the
+    reference shards it across Horovod DP ranks.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))

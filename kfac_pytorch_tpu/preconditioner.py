@@ -1,0 +1,316 @@
+"""KFAC: the distributed K-FAC gradient preconditioner (functional core).
+
+The TPU-native re-design of the reference's ``KFAC(optim.Optimizer)``
+(kfac_preconditioner.py:12-437). Where the reference mutates ``param.grad``
+in place via hooks + Horovod allreduces, this version is a pure transform:
+
+    kfac  = KFAC(...)
+    state = kfac.init(params)
+    new_grads, new_state = kfac.update(
+        grads, state, a_contribs=..., g_factor_stats=...,
+        lr=lr, damping=damping,
+        update_factors=..., update_eigen=...)   # static flags
+
+and chains in front of any SGD-like optimizer (optax). Key departures, all
+deliberate (SURVEY.md §7):
+
+* **No hooks** — statistics arrive explicitly from the capture machinery
+  (models/layers.py + capture.py).
+* **No factor allreduce** — A/G contributions are computed over the global
+  (mesh-sharded) batch inside the jitted step, so XLA already inserted the
+  mean-reduction the reference performs with ``hvd.allreduce(op=Average)``
+  (kfac_preconditioner.py:410-419).
+* **Step gating is host-side** — the trainer picks a step variant from the
+  host-known step counter instead of tracing ``steps % freq`` branches; lr
+  and damping stay traced scalars so schedulers never trigger recompiles.
+* **Eigen state is rebuilt, not mutated** — so ``diag_blocks`` transitions
+  need no ``_clear_eigen`` (kfac_preconditioner.py:167-178).
+* **State is a checkpointable pytree** — unlike the reference, which loses
+  all curvature state on resume (SURVEY.md §3.4 note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.ops import factors as factor_ops
+from kfac_pytorch_tpu.ops import precondition as precond_ops
+from kfac_pytorch_tpu.parallel.assignment import layer_assignment
+from kfac_pytorch_tpu.parallel.sharded_eigh import (
+    replicated_eigen_update,
+    sharded_eigen_update,
+)
+
+PyTree = Any
+KFACState = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class KFACHParams:
+    """Host-side mutable hyperparameters (the ``param_groups`` analog).
+
+    ``KFACParamScheduler`` mutates these between epochs; ``lr``/``damping``
+    enter the compiled step as traced scalars, the update freqs drive
+    host-side step-variant dispatch (kfac_preconditioner.py:351-356).
+    """
+
+    lr: float = 0.1
+    damping: float = 0.001
+    kl_clip: float = 0.001
+    fac_update_freq: int = 10
+    kfac_update_freq: int = 100
+
+
+def _validate(name: str, ok: bool, value) -> None:
+    if not ok:
+        raise ValueError(f"Invalid {name}: {value}")
+
+
+class KFAC:
+    """Distributed K-FAC gradient preconditioner.
+
+    Args mirror the reference ``KFAC.__init__`` (kfac_preconditioner.py:59-91)
+    with identical defaults and validation; ``mesh``/``axis_name`` replace the
+    implicit Horovod world.
+    """
+
+    def __init__(
+        self,
+        lr: float = 0.1,
+        factor_decay: float = 0.95,
+        damping: float = 0.001,
+        kl_clip: float = 0.001,
+        fac_update_freq: int = 10,
+        kfac_update_freq: int = 100,
+        batch_averaged: bool = True,
+        diag_blocks: int = 1,
+        diag_warmup: int = 0,
+        distribute_layer_factors: Optional[bool] = None,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "data",
+        eps: float = 1e-10,
+        layers: Optional[list] = None,
+    ):
+        _validate("learning rate", 0.0 <= lr, lr)
+        _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
+        _validate("damping", 0.0 < damping, damping)
+        _validate("clipping value", 0.0 < kl_clip, kl_clip)
+        _validate("factor update frequency", 0 < fac_update_freq, fac_update_freq)
+        _validate("K-FAC update frequency", 0 < kfac_update_freq, kfac_update_freq)
+        _validate("diagonal block approx count", 0 < diag_blocks, diag_blocks)
+        if kfac_update_freq % fac_update_freq != 0:
+            print(
+                "WARNING: it is suggested that kfac_update_freq be a multiple "
+                "of fac_update_freq"
+            )
+        if diag_blocks != 1:
+            print(
+                "WARNING: diag_blocks > 1 is experimental and may give poor "
+                "results."
+            )
+
+        self.factor_decay = factor_decay
+        self.batch_averaged = batch_averaged
+        self.diag_blocks = diag_blocks
+        self.diag_warmup = diag_warmup
+        self.distribute_layer_factors = distribute_layer_factors
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.eps = eps
+        # Explicit layer allowlist (from capture.discover_layers). None →
+        # params heuristic; REQUIRED for models mixing in non-K-FAC
+        # kernel-bearing modules (grouped convs, plain nn.Dense).
+        self.layers = list(layers) if layers is not None else None
+        self.hparams = KFACHParams(
+            lr=lr,
+            damping=damping,
+            kl_clip=kl_clip,
+            fac_update_freq=fac_update_freq,
+            kfac_update_freq=kfac_update_freq,
+        )
+
+    # ------------------------------------------------------------------
+    # Layer discovery
+    # ------------------------------------------------------------------
+
+    def _layer_meta(self, params: PyTree):
+        names = self.layers if self.layers is not None else capture.layer_names(params)
+        is_conv = {}
+        for name in names:
+            node = params
+            for k in name.split("/"):
+                node = node[k]
+            is_conv[name] = node["kernel"].ndim == 4
+        return names, is_conv
+
+    def _world(self) -> int:
+        if self.mesh is None:
+            return 1
+        return self.mesh.devices.size
+
+    # ------------------------------------------------------------------
+    # State
+    # ------------------------------------------------------------------
+
+    def init(self, params: PyTree) -> KFACState:
+        """Identity factors + zero eigen state (kfac_preconditioner.py:155-165).
+
+        Identity init followed by the first EMA update reproduces the
+        reference's ``steps == 0`` behavior (``A₀ = decay·I + (1−decay)·a``).
+        """
+        names, _ = self._layer_meta(params)
+        facs, eigen = {}, {}
+        for name in names:
+            node = params
+            for k in name.split("/"):
+                node = node[k]
+            kernel = node["kernel"]
+            has_bias = "bias" in node
+            if kernel.ndim == 4:
+                kh, kw, cin, cout = kernel.shape
+                a_side = cin * kh * kw + int(has_bias)
+                g_side = cout
+            else:
+                cin, cout = kernel.shape
+                a_side = cin + int(has_bias)
+                g_side = cout
+            facs[name] = {
+                "A": jnp.eye(a_side, dtype=jnp.float32),
+                "G": jnp.eye(g_side, dtype=jnp.float32),
+            }
+            eigen[name] = {
+                "QA": jnp.zeros((a_side, a_side), jnp.float32),
+                "dA": jnp.zeros((a_side,), jnp.float32),
+                "QG": jnp.zeros((g_side, g_side), jnp.float32),
+                "dG": jnp.zeros((g_side,), jnp.float32),
+            }
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "factors": facs,
+            "eigen": eigen,
+        }
+
+    # ------------------------------------------------------------------
+    # Update
+    # ------------------------------------------------------------------
+
+    def update(
+        self,
+        grads: PyTree,
+        state: KFACState,
+        *,
+        a_contribs: Optional[Dict[str, jnp.ndarray]] = None,
+        g_factor_stats: Optional[Dict[str, jnp.ndarray]] = None,
+        lr: Optional[jnp.ndarray] = None,
+        damping: Optional[jnp.ndarray] = None,
+        update_factors: bool,
+        update_eigen: bool,
+        diag_warmup_done: bool = True,
+    ) -> Tuple[PyTree, KFACState]:
+        """One K-FAC step (kfac_preconditioner.py:336-408), functional.
+
+        ``update_factors``/``update_eigen``/``diag_warmup_done`` are STATIC —
+        the trainer derives them host-side from the step counter and epoch
+        (see ``training.step.kfac_flags_for_step``); each combination is its
+        own compiled program, so non-update steps pay zero capture/eigh cost.
+        ``a_contribs``/``g_factor_stats`` come from capture.py and are
+        required iff ``update_factors``. ``lr``/``damping`` default to the
+        host-side ``hparams`` values (note: passing them as traced scalars
+        avoids recompilation when schedules change).
+        """
+        if lr is None:
+            lr = self.hparams.lr
+        if damping is None:
+            damping = self.hparams.damping
+        # The layer set was fixed at init() — state IS the source of truth,
+        # so a heuristic/params mismatch cannot silently widen the set here.
+        names = list(state["factors"].keys())
+        is_conv = {}
+        for name in names:
+            node = grads
+            for k in name.split("/"):
+                node = node[k]
+            is_conv[name] = node["kernel"].ndim == 4
+
+        facs = state["factors"]
+        if update_factors:
+            if a_contribs is None or g_factor_stats is None:
+                raise ValueError(
+                    "update_factors=True requires a_contribs and g_factor_stats"
+                )
+            missing = [n for n in names if n not in a_contribs or n not in g_factor_stats]
+            if missing:
+                raise ValueError(
+                    f"no captured statistics for layers {missing}; the model "
+                    "contains kernel-bearing modules that are not K-FAC "
+                    "capture-aware — construct KFAC(layers=capture."
+                    "discover_layers(model, ...)) so init() matches capture."
+                )
+            facs = {
+                name: {
+                    "A": factor_ops.update_running_avg(
+                        a_contribs[name], facs[name]["A"], self.factor_decay
+                    ),
+                    "G": factor_ops.update_running_avg(
+                        g_factor_stats[name], facs[name]["G"], self.factor_decay
+                    ),
+                }
+                for name in names
+            }
+
+        eigen = state["eigen"]
+        if update_eigen:
+            # diag_warmup: use 1 block until `epoch >= diag_warmup`
+            # (kfac_preconditioner.py:361-367), via the static flag.
+            diag_blocks = self.diag_blocks if diag_warmup_done else 1
+            world = self._world()
+            if world > 1:
+                table = layer_assignment(
+                    names,
+                    is_conv,
+                    world,
+                    self.distribute_layer_factors,
+                    diag_blocks,
+                )
+                eigen = sharded_eigen_update(
+                    facs, table, self.mesh, self.axis_name, self.eps
+                )
+            else:
+                blocks = {
+                    name: (diag_blocks if is_conv[name] else 1) for name in names
+                }
+                eigen = replicated_eigen_update(facs, blocks, self.eps)
+
+        # Precondition every layer's gradient, every step
+        # (kfac_preconditioner.py:401-404).
+        lgrads = capture.layer_grads(grads, names)
+        gmats = capture.grad_mats(lgrads)
+        updates = {
+            name: precond_ops.precondition_mat(
+                gmats[name].astype(jnp.float32),
+                eigen[name]["QA"],
+                eigen[name]["QG"],
+                eigen[name]["dA"],
+                eigen[name]["dG"],
+                damping,
+            )
+            for name in names
+        }
+
+        # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
+        nu = precond_ops.kl_clip_coefficient(
+            updates, gmats, lr, self.hparams.kl_clip
+        )
+        new_grads = capture.write_back(grads, updates, nu)
+
+        new_state = {
+            "step": state["step"] + 1,
+            "factors": facs,
+            "eigen": eigen,
+        }
+        return new_grads, new_state

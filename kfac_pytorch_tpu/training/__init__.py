@@ -1,0 +1,19 @@
+"""Training harness: jitted train/eval steps, schedules, data, checkpointing.
+
+The TPU-native analog of the reference's example-script machinery
+(examples/pytorch_cifar10_resnet.py et al.): instead of hook-driven
+optimizer wrapping + hand-rolled Horovod synchronization, ONE jitted SPMD
+program per step variant computes forward, backward, grad averaging, K-FAC
+statistics/preconditioning and the SGD update — XLA schedules and overlaps
+every collective.
+"""
+
+from kfac_pytorch_tpu.training.step import TrainState, make_eval_step, make_train_step
+from kfac_pytorch_tpu.training.schedules import create_lr_schedule
+
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "create_lr_schedule",
+]

@@ -1,0 +1,191 @@
+"""Input pipelines: CIFAR-10 (local pickle batches) + synthetic data.
+
+The reference uses torchvision datasets + Horovod ``DistributedSampler``
+(pytorch_cifar10_resnet.py:129-148). Here each host feeds the GLOBAL batch to
+the jitted step and the mesh sharding splits it across devices — no sampler
+machinery. This image is zero-egress, so CIFAR-10 loads from an existing
+``cifar-10-batches-py`` directory when present; synthetic data covers
+benchmarking and tests.
+
+NHWC float32 images, int32 labels. Augmentation (pad-4 random crop +
+horizontal flip, the reference's transform_train) is vectorized numpy.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+Batch = Tuple[np.ndarray, np.ndarray]
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+
+
+def load_cifar10(data_dir: str, train: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Load raw CIFAR-10 from the standard ``cifar-10-batches-py`` layout."""
+    base = data_dir
+    if os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py")):
+        base = os.path.join(data_dir, "cifar-10-batches-py")
+    files = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    xs, ys = [], []
+    for f in files:
+        with open(os.path.join(base, f), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        xs.append(d[b"data"])
+        ys.append(np.asarray(d[b"labels"], np.int32))
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+    x = x.astype(np.float32) / 255.0
+    x = (x - CIFAR10_MEAN) / CIFAR10_STD
+    return x, np.concatenate(ys)
+
+
+def _augment(x: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+    """Pad-4 random crop + horizontal flip, vectorized."""
+    n, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    out = np.empty_like(x)
+    ys = rng.randint(0, 9, size=n)
+    xs = rng.randint(0, 9, size=n)
+    flip = rng.rand(n) < 0.5
+    for i in range(n):
+        img = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
+        out[i] = img[:, ::-1] if flip[i] else img
+    return out
+
+
+def epoch_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    shuffle: bool,
+    augment: bool,
+    seed: int,
+) -> Iterator[Batch]:
+    """One epoch of full batches (drops the ragged tail, like drop_last)."""
+    rng = np.random.RandomState(seed)
+    idx = np.arange(len(x))
+    if shuffle:
+        rng.shuffle(idx)
+    n_batches = len(x) // batch_size
+    for b in range(n_batches):
+        take = idx[b * batch_size : (b + 1) * batch_size]
+        xb = x[take]
+        if augment:
+            xb = _augment(xb, rng)
+        yield xb, y[take]
+
+
+def synthetic_batches(
+    batch_size: int,
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    steps: int,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """Deterministic fake data: a small pool of pre-generated batches cycled.
+
+    Keeps host CPU out of the measurement loop for benchmarking.
+    """
+    rng = np.random.RandomState(seed)
+    pool = []
+    for _ in range(min(steps, 8)):
+        pool.append(
+            (
+                rng.randn(batch_size, *image_shape).astype(np.float32),
+                rng.randint(0, num_classes, size=batch_size).astype(np.int32),
+            )
+        )
+    for i in range(steps):
+        yield pool[i % len(pool)]
+
+
+# ---------------------------------------------------------------------------
+# WikiText (word-level LM)
+# ---------------------------------------------------------------------------
+
+
+def build_corpus(data_dir: str):
+    """Word-level corpus from WikiText-style token files.
+
+    Expects ``wiki.{train,valid,test}.tokens`` (WikiText-2/103 layout; the
+    reference consumed the same data via torchtext,
+    pytorch_wikitext_rnn.py:141-160). Returns (splits dict of int32 id
+    arrays, vocab list).
+    """
+    vocab = {"<unk>": 0, "<eos>": 1}
+    words = ["<unk>", "<eos>"]
+
+    def encode(path):
+        ids = []
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                for w in line.split() + ["<eos>"]:
+                    if w not in vocab:
+                        vocab[w] = len(words)
+                        words.append(w)
+                    ids.append(vocab[w])
+        return np.asarray(ids, np.int32)
+
+    splits = {}
+    for split in ("train", "valid", "test"):
+        p = os.path.join(data_dir, f"wiki.{split}.tokens")
+        if os.path.isfile(p):
+            splits[split] = encode(p)
+    return splits, words
+
+
+def synthetic_corpus(vocab_size: int = 1000, length: int = 200_000, seed: int = 0):
+    """Markov-ish synthetic token stream (zero-egress stand-in)."""
+    rng = np.random.RandomState(seed)
+    # Zipf-distributed tokens so the LM has actual structure to learn
+    probs = 1.0 / np.arange(1, vocab_size + 1)
+    probs /= probs.sum()
+    ids = rng.choice(vocab_size, size=length, p=probs).astype(np.int32)
+    return {"train": ids[: int(0.8 * length)],
+            "valid": ids[int(0.8 * length): int(0.9 * length)],
+            "test": ids[int(0.9 * length):]}, [f"w{i}" for i in range(vocab_size)]
+
+
+def batchify_tokens(ids: np.ndarray, batch_size: int) -> np.ndarray:
+    """``[N] -> [batch_size, N//batch_size]`` contiguous streams per row."""
+    n = len(ids) // batch_size
+    return ids[: n * batch_size].reshape(batch_size, n)
+
+
+def bptt_batches(stream: np.ndarray, bptt: int) -> Iterator[Batch]:
+    """Yield (tokens, next-token targets) [B, bptt] segments in order.
+
+    A segment starting at i needs targets through column i+bptt, so the last
+    valid start is n-1-bptt (inclusive) — hence the exclusive stop n-bptt.
+    """
+    _, n = stream.shape
+    for i in range(0, n - bptt, bptt):
+        yield stream[:, i : i + bptt], stream[:, i + 1 : i + 1 + bptt]
+
+
+def find_wikitext(data_dir: Optional[str]) -> Optional[str]:
+    """Locate a WikiText token directory, else None (→ synthetic)."""
+    candidates = [data_dir] if data_dir else []
+    candidates += ["/root/data/wikitext-2", "/data/wikitext-2"]
+    for c in candidates:
+        if c and os.path.isfile(os.path.join(c, "wiki.train.tokens")):
+            return c
+    return None
+
+
+def find_cifar10(data_dir: Optional[str]) -> Optional[str]:
+    """Locate a usable CIFAR-10 directory, else None (→ synthetic)."""
+    candidates = [data_dir] if data_dir else []
+    candidates += ["/root/data", "/data", os.path.expanduser("~/data")]
+    for c in candidates:
+        if not c:
+            continue
+        if os.path.isdir(os.path.join(c, "cifar-10-batches-py")) or os.path.isfile(
+            os.path.join(c, "data_batch_1")
+        ):
+            return c
+    return None

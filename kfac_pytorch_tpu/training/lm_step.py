@@ -1,0 +1,170 @@
+"""Language-model train step: truncated BPTT + grad clip + K-FAC.
+
+The RNN analog of training/step.py, mirroring the reference WikiText trainer
+(pytorch_wikitext_rnn.py): hidden-state repackaging between bptt segments
+(:224-229 — realized as ``lax.stop_gradient`` on the incoming carry), global
+grad-norm clipping applied BETWEEN grad averaging and preconditioning
+(:297-300), and perplexity metrics (:254-260). Unlike the reference — whose
+K-FAC path crashes (stale kwargs, SURVEY.md §2.2) — this one actually
+preconditions the decoder.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
+from kfac_pytorch_tpu.preconditioner import KFAC
+from kfac_pytorch_tpu.training.step import TrainState, softmax_cross_entropy
+
+PyTree = Any
+
+
+def _clip_by_global_norm(grads: PyTree, max_norm: float) -> PyTree:
+    """torch.nn.utils.clip_grad_norm_ semantics (scale if above max)."""
+    gnorm = optax.global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def make_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    kfac: Optional[KFAC] = None,
+    grad_clip: float = 0.25,
+):
+    """Build the jitted LM train step.
+
+    ``step_fn(state, batch, carry, dropout_rng, lr, damping,
+    update_factors=..., update_eigen=...)`` → ``(state, new_carry, metrics)``.
+    ``carry`` is the recurrent state threaded across bptt segments.
+    """
+
+    def train_step(
+        state: TrainState,
+        batch: Tuple[jnp.ndarray, jnp.ndarray],
+        carry,
+        dropout_rng,
+        lr,
+        damping,
+        *,
+        update_factors: bool = False,
+        update_eigen: bool = False,
+        diag_warmup_done: bool = True,
+    ):
+        tokens, targets = batch  # [B, T] each
+        carry = jax.lax.stop_gradient(carry)  # truncate BPTT at segment edge
+        rngs = {"dropout": dropout_rng}
+        capture_stats = kfac is not None and update_factors
+
+        if capture_stats:
+            perts = capture.perturbation_zeros(model, tokens, train=True)
+
+            def loss_fn(params, perts):
+                (logits, new_carry), mut = model.apply(
+                    {"params": params, PERTURBATIONS: perts},
+                    tokens,
+                    carry=carry,
+                    train=True,
+                    mutable=[KFAC_ACTS],
+                    rngs=rngs,
+                )
+                loss = softmax_cross_entropy(
+                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+                )
+                return loss, (mut, new_carry)
+
+            (loss, (mut, new_carry)), (grads, gperts) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(state.params, perts)
+            names = (
+                kfac.layers
+                if kfac.layers is not None
+                else capture.layer_names_from_capture(mut[KFAC_ACTS])
+            )
+            a_c = capture.a_contribs(mut[KFAC_ACTS], names)
+            g_s = capture.g_factors(gperts, names, batch_averaged=kfac.batch_averaged)
+        else:
+
+            def loss_fn(params):
+                logits, new_carry = model.apply(
+                    {"params": params}, tokens, carry=carry, train=True, rngs=rngs
+                )
+                loss = softmax_cross_entropy(
+                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+                )
+                return loss, new_carry
+
+            (loss, new_carry), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            a_c = g_s = None
+
+        if grad_clip:
+            grads = _clip_by_global_norm(grads, grad_clip)
+
+        kfac_state = state.kfac_state
+        if kfac is not None:
+            grads, kfac_state = kfac.update(
+                grads,
+                kfac_state,
+                a_contribs=a_c,
+                g_factor_stats=g_s,
+                lr=lr,
+                damping=damping,
+                update_factors=update_factors,
+                update_eigen=update_eigen,
+                diag_warmup_done=diag_warmup_done,
+            )
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
+        params = optax.apply_updates(state.params, updates)
+
+        metrics = {"loss": loss, "ppl": jnp.exp(loss)}
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=state.batch_stats,
+            opt_state=opt_state,
+            kfac_state=kfac_state,
+        )
+        return new_state, new_carry, metrics
+
+    return jax.jit(
+        train_step,
+        static_argnames=("update_factors", "update_eigen", "diag_warmup_done"),
+        donate_argnames=("state",),
+    )
+
+
+def make_lm_eval_step(model):
+    """Jitted eval: carry-threaded, no dropout → ``{'loss','ppl'}``."""
+
+    def eval_step(state: TrainState, batch, carry):
+        tokens, targets = batch
+        logits, new_carry = model.apply(
+            {"params": state.params}, tokens, carry=carry, train=False
+        )
+        loss = softmax_cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+        )
+        return {"loss": loss, "ppl": jnp.exp(loss)}, new_carry
+
+    return jax.jit(eval_step)
+
+
+def init_carry(model, params, tokens) -> Any:
+    """Zero recurrent carry for a batch shape (train-loop epoch start)."""
+    logits_carry = jax.eval_shape(
+        lambda: model.apply({"params": params}, tokens, train=False)
+    )
+    _, carry_shapes = logits_carry
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), carry_shapes
+    )

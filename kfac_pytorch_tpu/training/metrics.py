@@ -1,0 +1,72 @@
+"""Metrics accumulation + scalar logging (TensorBoard when available).
+
+The reference's ``Metric`` does a blocking ``hvd.allreduce`` per update
+(examples/utils.py:38-50); here per-batch metrics come out of the jitted step
+already reduced over the global batch, so accumulation is plain host-side
+averaging. TensorBoard writing degrades gracefully to JSONL on images
+without the tensorboard package (this one), keeping the scalar stream
+machine-readable either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class Metric:
+    """Running mean of a scalar stream (examples/utils.py:38-50 analog)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.n = 0
+
+    def update(self, value: float) -> None:
+        self.total += float(value)
+        self.n += 1
+
+    @property
+    def avg(self) -> float:
+        return self.total / max(self.n, 1)
+
+
+class ScalarWriter:
+    """TensorBoard ``SummaryWriter`` if importable, else JSONL scalars.
+
+    Rank-0-only, like the reference's writer (pytorch_cifar10_resnet.py:
+    108-113).
+    """
+
+    def __init__(self, log_dir: Optional[str], enabled: bool = True):
+        self._tb = None
+        self._fh = None
+        if not (enabled and log_dir):
+            return
+        os.makedirs(log_dir, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+            self._tb = SummaryWriter(log_dir)
+        except Exception:
+            self._fh = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        elif self._fh is not None:
+            self._fh.write(
+                json.dumps(
+                    {"ts": time.time(), "tag": tag, "value": float(value), "step": step}
+                )
+                + "\n"
+            )
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._fh is not None:
+            self._fh.close()

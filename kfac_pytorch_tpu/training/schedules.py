@@ -1,0 +1,33 @@
+"""Learning-rate schedules with reference parity.
+
+``create_lr_schedule`` mirrors examples/utils.py:52-63: linear warmup of the
+lr *factor* from 1/workers → 1 over ``warmup_epochs``, then multiplicative
+decay by ``alpha`` at each epoch in ``decay_schedule``. The caller multiplies
+by the world-scaled base lr (``base_lr × workers``), matching the reference's
+``args.base_lr * hvd.size()`` convention (pytorch_cifar10_resnet.py:168).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+
+def create_lr_schedule(
+    workers: int,
+    warmup_epochs: float,
+    decay_schedule: Sequence[int],
+    alpha: float = 0.1,
+) -> Callable[[float], float]:
+    """Returns ``epoch (float) -> lr factor`` (host-side, cheap per step)."""
+    decay = sorted(decay_schedule)
+
+    def lr_factor(epoch: float) -> float:
+        if warmup_epochs > 0 and epoch < warmup_epochs:
+            return 1.0 / workers + (1.0 - 1.0 / workers) * (epoch / warmup_epochs)
+        f = 1.0
+        for e in decay:
+            if epoch >= e:
+                f *= alpha
+        return f
+
+    return lr_factor

@@ -1,0 +1,245 @@
+"""Jitted train/eval steps: forward + vjp + K-FAC + SGD in one XLA program.
+
+Replaces the reference's per-batch hot loop (pytorch_cifar10_resnet.py:
+220-241): where torch needed ``optimizer.synchronize()`` (grad allreduce
+barrier) → ``preconditioner.step()`` (factor/eigen allreduces) →
+``optimizer.step()`` as three separately-synchronized phases, here the whole
+thing is ONE compiled SPMD program per step variant — the batch is sharded
+over the mesh's data axis, so XLA inserts and overlaps every collective
+(grad mean, factor mean, eigendecomp exchange) automatically.
+
+Step variants are selected HOST-side from the step counter and the K-FAC
+update frequencies (the ``steps % freq`` gates of kfac_preconditioner.py:
+369-399 are host-known), so plain steps trace no capture/eigh code at all.
+Each (update_factors, update_eigen) combination compiles once and is cached.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
+from kfac_pytorch_tpu.preconditioner import KFAC
+
+PyTree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Full training state pytree (checkpointable, incl. K-FAC curvature)."""
+
+    step: jnp.ndarray
+    params: PyTree
+    batch_stats: PyTree
+    opt_state: PyTree
+    kfac_state: Optional[PyTree] = None
+
+
+def make_sgd(momentum: float = 0.9, weight_decay: float = 0.0):
+    """SGD pieces matching ``torch.optim.SGD`` semantics.
+
+    Weight decay is added to the (preconditioned) gradient, then momentum,
+    then the lr scaling — the exact order torch applies when K-FAC has
+    rewritten ``param.grad`` (SURVEY.md §1 integration contract). lr stays a
+    traced scalar (applied by the train step), so schedulers never recompile.
+    """
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.trace(decay=momentum, nesterov=False))
+    return optax.chain(*chain)
+
+
+def softmax_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
+) -> jnp.ndarray:
+    """Mean CE with optional label smoothing (examples/utils.py:19-31)."""
+    num_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        onehot = (1.0 - label_smoothing) * onehot + label_smoothing / num_classes
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def _variables(params, batch_stats, extra=None):
+    v = {"params": params}
+    if batch_stats:
+        v["batch_stats"] = batch_stats
+    if extra:
+        v.update(extra)
+    return v
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    kfac: Optional[KFAC] = None,
+    label_smoothing: float = 0.0,
+    train_kwargs: Optional[dict] = None,
+):
+    """Build the jitted train step.
+
+    Returns ``step_fn(state, batch, lr, damping, update_factors=...,
+    update_eigen=...)`` → ``(state, metrics)``. ``lr``/``damping`` are traced
+    scalars; the two flags are static (compile-cached per combination).
+    With ``kfac=None`` this is the plain-SGD baseline path (the reference's
+    ``--kfac-update-freq 0`` mode, pytorch_cifar10_resnet.py:169).
+    """
+    train_kwargs = dict(train_kwargs or {})
+
+    def loss_and_grads_captured(state, images, labels):
+        perts = capture.perturbation_zeros(model, images, **train_kwargs)
+        has_bn = bool(state.batch_stats)
+        mutable = (["batch_stats"] if has_bn else []) + [KFAC_ACTS]
+
+        def loss_fn(params, perts):
+            out = model.apply(
+                _variables(params, state.batch_stats, {PERTURBATIONS: perts}),
+                images,
+                mutable=mutable,
+                **train_kwargs,
+            )
+            logits, mut = out
+            loss = softmax_cross_entropy(logits, labels, label_smoothing)
+            return loss, (mut, logits)
+
+        (loss, (mut, logits)), (grads, gperts) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(state.params, perts)
+        if kfac is not None and kfac.layers is not None:
+            names = kfac.layers
+        else:
+            names = capture.layer_names_from_capture(mut[KFAC_ACTS])
+        a_c = capture.a_contribs(mut[KFAC_ACTS], names)
+        g_s = capture.g_factors(
+            gperts, names, batch_averaged=kfac.batch_averaged if kfac else True
+        )
+        new_bs = mut.get("batch_stats", state.batch_stats)
+        return loss, logits, grads, new_bs, a_c, g_s
+
+    def loss_and_grads_plain(state, images, labels):
+        has_bn = bool(state.batch_stats)
+        mutable = ["batch_stats"] if has_bn else []
+
+        def loss_fn(params):
+            out = model.apply(
+                _variables(params, state.batch_stats),
+                images,
+                mutable=mutable,
+                **train_kwargs,
+            )
+            logits, mut = out if mutable else (out, {})
+            loss = softmax_cross_entropy(logits, labels, label_smoothing)
+            return loss, (mut, logits)
+
+        (loss, (mut, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        new_bs = mut.get("batch_stats", state.batch_stats)
+        return loss, logits, grads, new_bs, None, None
+
+    def train_step(
+        state: TrainState,
+        batch: Tuple[jnp.ndarray, jnp.ndarray],
+        lr: jnp.ndarray,
+        damping: jnp.ndarray,
+        *,
+        update_factors: bool = False,
+        update_eigen: bool = False,
+        diag_warmup_done: bool = True,
+    ):
+        images, labels = batch
+        capture_stats = kfac is not None and update_factors
+        if capture_stats:
+            loss, logits, grads, new_bs, a_c, g_s = loss_and_grads_captured(
+                state, images, labels
+            )
+        else:
+            loss, logits, grads, new_bs, a_c, g_s = loss_and_grads_plain(
+                state, images, labels
+            )
+
+        kfac_state = state.kfac_state
+        if kfac is not None:
+            grads, kfac_state = kfac.update(
+                grads,
+                kfac_state,
+                a_contribs=a_c,
+                g_factor_stats=g_s,
+                lr=lr,
+                damping=damping,
+                update_factors=update_factors,
+                update_eigen=update_eigen,
+                diag_warmup_done=diag_warmup_done,
+            )
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
+        params = optax.apply_updates(state.params, updates)
+
+        metrics = {
+            "loss": loss,
+            "accuracy": jnp.mean(
+                (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+            ),
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_bs,
+            opt_state=opt_state,
+            kfac_state=kfac_state,
+        )
+        return new_state, metrics
+
+    return jax.jit(
+        train_step,
+        static_argnames=("update_factors", "update_eigen", "diag_warmup_done"),
+        donate_argnames=("state",),
+    )
+
+
+def make_eval_step(model, label_smoothing: float = 0.0, eval_kwargs: Optional[dict] = None):
+    """Jitted eval step → ``{'loss', 'accuracy'}`` means over the batch."""
+    eval_kwargs = dict(eval_kwargs or {})
+
+    def eval_step(state: TrainState, batch):
+        images, labels = batch
+        logits = model.apply(
+            _variables(state.params, state.batch_stats), images, **eval_kwargs
+        )
+        return {
+            "loss": softmax_cross_entropy(logits, labels, label_smoothing),
+            "accuracy": jnp.mean(
+                (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+            ),
+        }
+
+    return jax.jit(eval_step)
+
+
+def kfac_flags_for_step(
+    step: int, kfac: Optional[KFAC], epoch: Optional[int] = None
+) -> dict:
+    """Host-side step gating (kfac_preconditioner.py:369,383).
+
+    Derives the static flags from the host-known step counter, the
+    (scheduler-mutable) update frequencies, and — for the ``diag_warmup``
+    gate (kfac_preconditioner.py:361-367) — the current epoch (None → no
+    warmup gating, matching the reference's warning path).
+    """
+    if kfac is None:
+        return {"update_factors": False, "update_eigen": False}
+    hp = kfac.hparams
+    return {
+        "update_factors": step % hp.fac_update_freq == 0,
+        "update_eigen": step % hp.kfac_update_freq == 0,
+        "diag_warmup_done": epoch is None or epoch >= kfac.diag_warmup,
+    }

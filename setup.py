@@ -1,0 +1,20 @@
+"""Packaging (parity: reference setup.py ships only the library package)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="kfac_pytorch_tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native distributed K-FAC gradient preconditioner (JAX/XLA)"
+    ),
+    packages=find_packages(include=["kfac_pytorch_tpu", "kfac_pytorch_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "orbax-checkpoint",
+        "numpy",
+    ],
+)

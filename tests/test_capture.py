@@ -1,0 +1,118 @@
+"""Capture machinery: sow'd A contributions + perturbation grad-outputs."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.models.layers import (
+    KFAC_ACTS,
+    PERTURBATIONS,
+    KFACConv,
+    KFACDense,
+)
+from kfac_pytorch_tpu.ops import factors
+
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = KFACConv(features=4, kernel_size=(3, 3), name="c1")(x)
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = KFACDense(features=3, name="d1")(x)
+        return x
+
+
+def _setup():
+    m = Tiny()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 5, 3).astype(np.float32))
+    vs = m.init(jax.random.PRNGKey(0), x)
+    return m, x, vs
+
+
+def test_layer_names_and_ordering():
+    _, _, vs = _setup()
+    assert capture.layer_names(vs["params"]) == ["c1", "d1"]
+
+
+def test_apply_without_capture_collections():
+    m, x, vs = _setup()
+    y = m.apply({"params": vs["params"]}, x)
+    assert y.shape == (2, 3)
+
+
+def test_a_contrib_matches_direct_factor_math():
+    m, x, vs = _setup()
+    _, mut = m.apply(
+        {"params": vs["params"], PERTURBATIONS: jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS])},
+        x,
+        mutable=[KFAC_ACTS],
+    )
+    ac = capture.a_contribs(mut[KFAC_ACTS], ["c1", "d1"])
+    want_c1 = factors.compute_a_conv(x, (3, 3), (1, 1), "SAME", has_bias=False)
+    np.testing.assert_allclose(np.asarray(ac["c1"]), np.asarray(want_c1), atol=1e-5)
+    assert ac["d1"].shape == (101, 101)  # 4*5*5 inputs + bias column
+
+
+def test_perturbation_grads_are_true_output_grads():
+    m, x, vs = _setup()
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+
+    def loss_fn(params, perts):
+        y = m.apply({"params": params, PERTURBATIONS: perts}, x)
+        return jnp.sum(y**2)
+
+    gpert = jax.grad(loss_fn, argnums=1)(vs["params"], perts)
+    y = m.apply({"params": vs["params"]}, x)
+    # d(sum y²)/dy = 2y at the final layer output
+    np.testing.assert_allclose(
+        np.asarray(gpert["d1"]["out"]), np.asarray(2 * y), atol=1e-5
+    )
+    # conv output grad has the conv output's NHWC shape
+    assert gpert["c1"]["out"].shape == (2, 5, 5, 4)
+
+
+def test_g_factors_rank_dispatch():
+    m, x, vs = _setup()
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+    gpert = jax.grad(
+        lambda p, q: jnp.sum(m.apply({"params": p, PERTURBATIONS: q}, x) ** 2),
+        argnums=1,
+    )(vs["params"], perts)
+    gf = capture.g_factors(gpert, ["c1", "d1"], batch_averaged=True)
+    assert gf["c1"].shape == (4, 4)
+    assert gf["d1"].shape == (3, 3)
+    want_d1 = factors.compute_g_dense(gpert["d1"]["out"], batch_averaged=True)
+    np.testing.assert_allclose(np.asarray(gf["d1"]), np.asarray(want_d1), atol=1e-5)
+
+
+def test_write_back_preserves_untouched_leaves_and_dtypes():
+    m, x, vs = _setup()
+    grads = jax.grad(lambda p: jnp.sum(m.apply({"params": p}, x) ** 2))(vs["params"])
+    names = capture.layer_names(vs["params"])
+    gm = capture.grad_mats(capture.layer_grads(grads, names))
+    new = capture.write_back(grads, {n: 2 * gm[n] for n in names}, nu=0.5)
+    # 2x then nu=0.5 → identical to original
+    np.testing.assert_allclose(
+        np.asarray(new["c1"]["kernel"]), np.asarray(grads["c1"]["kernel"]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(new["d1"]["bias"]), np.asarray(grads["d1"]["bias"]), atol=1e-6
+    )
+    # original pytree not mutated
+    assert new is not grads
+
+
+def test_perturbation_zeros_shapes():
+    m, x, _ = _setup()
+    perts = capture.perturbation_zeros(m, x)
+    assert perts["c1"]["out"].shape == (2, 5, 5, 4)
+    assert perts["d1"]["out"].shape == (2, 3)
+    assert float(jnp.abs(perts["c1"]["out"]).max()) == 0.0

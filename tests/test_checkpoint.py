@@ -1,0 +1,57 @@
+"""Checkpoint/resume: full TrainState round-trip incl. K-FAC state."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models import cifar_resnet
+from kfac_pytorch_tpu.training import checkpoint as ckpt
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd
+
+
+def _state():
+    model = cifar_resnet.get_model("resnet20")
+    x = jnp.zeros((2, 16, 16, 3))
+    vs = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    kfac = KFAC()
+    return TrainState(
+        step=jnp.asarray(7, jnp.int32),
+        params=vs["params"],
+        batch_stats=vs.get("batch_stats", {}),
+        opt_state=tx.init(vs["params"]),
+        kfac_state=kfac.init(vs["params"]),
+    )
+
+
+def test_checkpoint_roundtrip_includes_kfac_state(tmp_path):
+    state = _state()
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 3, state)
+    assert ckpt.latest_epoch(d) == 3
+    restored, resume = ckpt.auto_resume(d, state)
+    assert resume == 4
+    assert int(restored.step) == 7
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state)),
+        jax.tree_util.tree_leaves(restored),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_latest_epoch_scans_newest(tmp_path):
+    state = _state()
+    d = str(tmp_path / "ckpts")
+    for e in (0, 2, 10):
+        ckpt.save_checkpoint(d, e, state)
+    assert ckpt.latest_epoch(d) == 10
+
+
+def test_auto_resume_without_checkpoints(tmp_path):
+    state = _state()
+    restored, resume = ckpt.auto_resume(str(tmp_path / "none"), state)
+    assert resume == 0
+    assert restored is state

@@ -1,0 +1,101 @@
+"""Model zoo: shapes, param counts (vs torchvision ground truth), capture."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.models import cifar_resnet, imagenet_resnet
+
+
+def _n_params(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(tree))
+
+
+def _init_abstract(model, shape):
+    return jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros(shape), train=True)
+    )
+
+
+@pytest.mark.parametrize(
+    "name,depth_blocks",
+    [("resnet20", 3), ("resnet32", 5), ("resnet56", 9)],
+)
+def test_cifar_resnet_structure(name, depth_blocks):
+    m = cifar_resnet.get_model(name)
+    vs = _init_abstract(m, (2, 32, 32, 3))
+    names = capture.layer_names(vs["params"])
+    # depth = 6n+2 preconditionable layers: 1 stem + 6n convs + 1 dense
+    assert len(names) == 6 * depth_blocks + 2
+
+
+def test_cifar_resnet20_param_count():
+    # ground truth: the reference zoo's __main__ smoke prints ~0.27M
+    m = cifar_resnet.get_model("resnet20")
+    vs = _init_abstract(m, (2, 32, 32, 3))
+    n = _n_params(vs["params"])
+    assert 0.26e6 < n < 0.28e6
+
+
+def test_cifar_forward_and_option_a_shortcut():
+    m = cifar_resnet.get_model("resnet20")
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    vs = m.init(jax.random.PRNGKey(0), x, train=True)
+    y, mut = m.apply(vs, x, train=True, mutable=["batch_stats"])
+    assert y.shape == (2, 10)
+    y_eval = m.apply(
+        {"params": vs["params"], "batch_stats": vs["batch_stats"]}, x, train=False
+    )
+    assert y_eval.shape == (2, 10)
+    # only the head has a bias (convs are bias-free, cifar_resnet.py:59-61)
+    biases = [k for k, v in capture._flatten_with_paths(vs["params"]) if k[-1] == "bias"
+              and "BatchNorm" not in "/".join(k)]
+    assert len(biases) == 1
+
+
+@pytest.mark.parametrize(
+    "name,want_m",
+    [
+        ("resnet18", 11.69), ("resnet34", 21.80), ("resnet50", 25.56),
+        ("resnet101", 44.55), ("resnext50_32x4d", 25.03),
+        ("wide_resnet50_2", 68.88),
+    ],
+)
+def test_imagenet_param_counts_match_torchvision(name, want_m):
+    m = imagenet_resnet.get_model(name)
+    vs = _init_abstract(m, (2, 224, 224, 3))
+    n = _n_params(vs["params"]) / 1e6
+    assert abs(n - want_m) < 0.15, f"{name}: {n:.2f}M vs {want_m}M"
+
+
+def test_imagenet_resnet50_forward():
+    m = imagenet_resnet.get_model("resnet50")
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    vs = m.init(jax.random.PRNGKey(0), x, train=True)
+    y, _ = m.apply(vs, x, train=True, mutable=["batch_stats"])
+    assert y.shape == (2, 1000)
+
+
+def test_resnext_grouped_convs_not_captured():
+    """Grouped convs are excluded from K-FAC (would be shape-inconsistent)."""
+    m = imagenet_resnet.get_model("resnext50_32x4d")
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    names = capture.discover_layers(m, x, train=True)
+    assert names, "discovery found no layers"
+    # authoritative discovery (capture collection) excludes every grouped conv
+    assert all("GroupedConv" not in n for n in names)
+    # ...whereas the raw params heuristic would wrongly include them — the
+    # reason ResNeXt-style models must pass KFAC(layers=discover_layers(...))
+    vs = _init_abstract(m, (2, 64, 64, 3))
+    heuristic = capture.layer_names(vs["params"])
+    assert any("GroupedConv" in n for n in heuristic)
+    assert set(names) <= set(heuristic)
+
+
+def test_unknown_model_name():
+    with pytest.raises(ValueError):
+        cifar_resnet.get_model("resnet99")
+    with pytest.raises(ValueError):
+        imagenet_resnet.get_model("alexnet")

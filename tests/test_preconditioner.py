@@ -1,0 +1,242 @@
+"""Preconditioner core: numpy oracle parity, sharded==replicated, scheduler.
+
+The oracle re-implements the reference algorithm (kfac_preconditioner.py:
+336-408) in pure numpy for dense layers and must agree with KFAC.update end
+to end (factors → EMA → eigh → precondition → KL clip → write-back).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
+from kfac_pytorch_tpu.parallel.assignment import RoundRobin, layer_assignment
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+
+
+def _dense_params(rng, sizes, bias=True):
+    params = {}
+    for i, (nin, nout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        layer = {"kernel": jnp.asarray(rng.randn(nin, nout).astype(np.float32))}
+        if bias:
+            layer["bias"] = jnp.asarray(rng.randn(nout).astype(np.float32))
+        params[f"l{i}"] = layer
+    return params
+
+
+def _stats_for(params, rng, batch=8):
+    """Synthetic activations / output-grads per layer + grads."""
+    a_contribs, g_stats, grads = {}, {}, {}
+    from kfac_pytorch_tpu.ops import factors as F
+
+    for name, layer in params.items():
+        nin, nout = layer["kernel"].shape
+        acts = jnp.asarray(rng.randn(batch, nin).astype(np.float32))
+        gout = jnp.asarray(rng.randn(batch, nout).astype(np.float32) / batch)
+        a_contribs[name] = F.compute_a_dense(acts, has_bias="bias" in layer)
+        g_stats[name] = F.compute_g_dense(gout, batch_averaged=True)
+        grads[name] = {
+            "kernel": jnp.asarray(rng.randn(nin, nout).astype(np.float32)),
+        }
+        if "bias" in layer:
+            grads[name]["bias"] = jnp.asarray(rng.randn(nout).astype(np.float32))
+    return a_contribs, g_stats, grads
+
+
+def _numpy_oracle(params, a_contribs, g_stats, grads, n_steps_state, lr, damping,
+                  kl_clip=0.001, decay=0.95, eps=1e-10):
+    """Reference algorithm in numpy. n_steps_state: list of per-step
+    (update_factors, update_eigen) to replay."""
+    names = list(params.keys())
+    A = {n: np.eye(a_contribs[n].shape[0], dtype=np.float64) for n in names}
+    G = {n: np.eye(g_stats[n].shape[0], dtype=np.float64) for n in names}
+    QA, QG, dA, dG = {}, {}, {}, {}
+    for upf, upe in n_steps_state:
+        if upf:
+            for n in names:
+                A[n] = decay * A[n] + (1 - decay) * np.asarray(a_contribs[n], np.float64)
+                G[n] = decay * G[n] + (1 - decay) * np.asarray(g_stats[n], np.float64)
+        if upe:
+            for n in names:
+                dA[n], QA[n] = np.linalg.eigh(A[n])
+                dG[n], QG[n] = np.linalg.eigh(G[n])
+                dA[n] = dA[n] * (dA[n] > eps)
+                dG[n] = dG[n] * (dG[n] > eps)
+    # precondition with final state
+    out = {}
+    vg_sum = 0.0
+    for n in names:
+        g = np.asarray(grads[n]["kernel"], np.float64).T
+        if "bias" in grads[n]:
+            g = np.concatenate([g, np.asarray(grads[n]["bias"], np.float64)[:, None]], 1)
+        v1 = QG[n].T @ g @ QA[n]
+        v2 = v1 / (dG[n][:, None] * dA[n][None, :] + damping)
+        v = QG[n] @ v2 @ QA[n].T
+        out[n] = v
+        vg_sum += (v * g).sum() * lr**2
+    nu = min(1.0, np.sqrt(kl_clip / abs(vg_sum)))
+    return {n: out[n] * nu for n in names}, nu
+
+
+def test_kfac_update_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    params = _dense_params(rng, [6, 5, 4])
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    kfac = KFAC(lr=0.1, damping=0.01)
+    state = kfac.init(params)
+    new_grads, state = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True,
+    )
+    want, nu = _numpy_oracle(
+        params, a_c, g_s, grads, [(True, True)], lr=0.1, damping=0.01
+    )
+    for n in params:
+        got = np.asarray(new_grads[n]["kernel"]).T
+        got = np.concatenate([got, np.asarray(new_grads[n]["bias"])[:, None]], 1)
+        np.testing.assert_allclose(got, want[n], rtol=1e-3, atol=1e-4)
+    assert int(state["step"]) == 1
+
+
+def test_factor_ema_accumulates_across_updates():
+    rng = np.random.RandomState(1)
+    params = _dense_params(rng, [4, 3], bias=False)
+    a_c, g_s, grads = _stats_for(params, rng)
+    kfac = KFAC()
+    state = kfac.init(params)
+    _, state = kfac.update(grads, state, a_contribs=a_c, g_factor_stats=g_s,
+                           lr=0.1, damping=0.01, update_factors=True, update_eigen=False)
+    _, state = kfac.update(grads, state, a_contribs=a_c, g_factor_stats=g_s,
+                           lr=0.1, damping=0.01, update_factors=True, update_eigen=False)
+    a = np.asarray(a_c["l0"], np.float64)
+    want = 0.95 * (0.95 * np.eye(4) + 0.05 * a) + 0.05 * a
+    np.testing.assert_allclose(np.asarray(state["factors"]["l0"]["A"]), want, atol=1e-5)
+
+
+def test_precondition_without_eigen_update_uses_stale_state():
+    rng = np.random.RandomState(2)
+    params = _dense_params(rng, [4, 3])
+    a_c, g_s, grads = _stats_for(params, rng)
+    kfac = KFAC()
+    state = kfac.init(params)
+    g1, state = kfac.update(grads, state, a_contribs=a_c, g_factor_stats=g_s,
+                            lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    # second call, no updates: same eigen state → same preconditioned grads
+    g2, state = kfac.update(grads, state, lr=0.1, damping=0.01,
+                            update_factors=False, update_eigen=False)
+    np.testing.assert_allclose(np.asarray(g1["l0"]["kernel"]),
+                               np.asarray(g2["l0"]["kernel"]), atol=1e-6)
+
+
+def test_sharded_eigen_matches_replicated():
+    rng = np.random.RandomState(3)
+    params = _dense_params(rng, [6, 5, 4, 3])
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    kfac_rep = KFAC(damping=0.01)
+    state = kfac_rep.init(params)
+    g_rep, s_rep = kfac_rep.update(grads, state, a_contribs=a_c, g_factor_stats=g_s,
+                                   lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+
+    mesh = data_parallel_mesh()
+    assert mesh.devices.size == 8
+    kfac_sh = KFAC(damping=0.01, mesh=mesh)
+    g_sh, s_sh = kfac_sh.update(grads, kfac_sh.init(params), a_contribs=a_c,
+                                g_factor_stats=g_s, lr=0.1, damping=0.01,
+                                update_factors=True, update_eigen=True)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_sh[n]["kernel"]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s_rep["eigen"][n]["dA"]),
+                                   np.asarray(s_sh["eigen"][n]["dA"]), atol=1e-5)
+
+
+def test_sharded_eigen_distribute_layer_factors_matches():
+    rng = np.random.RandomState(4)
+    params = _dense_params(rng, [6, 5, 4])
+    a_c, g_s, grads = _stats_for(params, rng)
+    mesh = data_parallel_mesh()
+    # world=8 > 2 layers → auto distribute A/G to different devices
+    kfac_sh = KFAC(damping=0.01, mesh=mesh)
+    g_sh, _ = kfac_sh.update(grads, kfac_sh.init(params), a_contribs=a_c,
+                             g_factor_stats=g_s, lr=0.1, damping=0.01,
+                             update_factors=True, update_eigen=True)
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, _ = kfac_rep.update(grads, kfac_rep.init(params), a_contribs=a_c,
+                               g_factor_stats=g_s, lr=0.1, damping=0.01,
+                               update_factors=True, update_eigen=True)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_sh[n]["kernel"]), rtol=1e-4, atol=1e-5)
+
+
+def test_round_robin_parity():
+    rr = RoundRobin(3)
+    assert rr.next(2) == (0, 1)
+    assert rr.next(1) == (2,)
+    assert rr.next(4) == (0, 1, 2, 0)
+    rr.reset()
+    assert rr.next(2) == (0, 1)
+
+
+def test_layer_assignment_auto_rule_and_pattern():
+    names = ["a", "b"]
+    is_conv = {"a": False, "b": False}
+    # world > layers → distribute: A and G on different ranks
+    t = layer_assignment(names, is_conv, world=4, distribute_layer_factors=None)
+    assert t["a"]["A"] == (0,) and t["a"]["G"] == (1,)
+    assert t["b"]["A"] == (2,) and t["b"]["G"] == (3,)
+    # world <= layers → A and G co-located
+    t2 = layer_assignment(names, is_conv, world=2, distribute_layer_factors=None)
+    assert t2["a"]["A"] == t2["a"]["G"] == (0,)
+    assert t2["b"]["A"] == t2["b"]["G"] == (1,)
+    # conv layers get diag_blocks owners
+    t3 = layer_assignment(["c"], {"c": True}, world=4,
+                          distribute_layer_factors=False, diag_blocks=2)
+    assert t3["c"]["A"] == (0, 1) and t3["c"]["G"] == (0, 1)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        KFAC(lr=-1)
+    with pytest.raises(ValueError):
+        KFAC(factor_decay=0)
+    with pytest.raises(ValueError):
+        KFAC(damping=0)
+    with pytest.raises(ValueError):
+        KFAC(kl_clip=0)
+    with pytest.raises(ValueError):
+        KFAC(fac_update_freq=0)
+    with pytest.raises(ValueError):
+        KFAC(kfac_update_freq=0)
+    with pytest.raises(ValueError):
+        KFAC(diag_blocks=0)
+
+
+def test_scheduler_parity():
+    kfac = KFAC(damping=0.002, fac_update_freq=10, kfac_update_freq=100)
+    sched = KFACParamScheduler(
+        kfac, damping_alpha=0.5, damping_schedule=[40, 80],
+        update_freq_alpha=2, update_freq_schedule=[30],
+    )
+    sched.step(epoch=39)
+    assert kfac.hparams.damping == 0.002
+    assert kfac.hparams.fac_update_freq == 20 and kfac.hparams.kfac_update_freq == 200
+    sched.step(epoch=40)
+    assert np.isclose(kfac.hparams.damping, 0.001)
+    sched.step(epoch=85)
+    assert np.isclose(kfac.hparams.damping, 0.0005)
+    # implicit epoch increment path
+    sched2 = KFACParamScheduler(KFAC(), start_epoch=0)
+    sched2.step()
+    assert sched2.epoch == 1
+
+
+def test_scheduler_resume_start_epoch():
+    kfac = KFAC(damping=0.002)
+    sched = KFACParamScheduler(kfac, damping_alpha=0.5, damping_schedule=[10],
+                               start_epoch=15)
+    sched.step(epoch=15)
+    assert np.isclose(kfac.hparams.damping, 0.001)
