@@ -2,21 +2,25 @@
 
 ``KFAC_FORCE_PLATFORM=cpu[:N]`` forces the JAX platform (optionally with N
 virtual host devices) — needed on images whose sitecustomize pre-imports jax
-and pins a remote TPU backend, where ``JAX_PLATFORMS`` alone is ignored.
-Import this FIRST in every example CLI.
+and pins a remote TPU backend, where ``JAX_PLATFORMS`` alone is ignored
+(see kfac_pytorch_tpu/platform_override.py). Import this FIRST in every
+example CLI.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _force = os.environ.get("KFAC_FORCE_PLATFORM")
 if _force:
     plat, _, n = _force.partition(":")
-    if n:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n}"
-            ).strip()
-    import jax
+    if plat != "cpu":
+        raise ValueError(f"KFAC_FORCE_PLATFORM only supports cpu[:N], got {_force!r}")
+    from kfac_pytorch_tpu.platform_override import force_cpu_devices
 
-    jax.config.update("jax_platforms", plat)
+    if not force_cpu_devices(int(n) if n else None):
+        raise RuntimeError(
+            "could not force the CPU platform — a JAX backend was already "
+            "instantiated before examples/_env.py was imported"
+        )

@@ -2,23 +2,15 @@
 
 Multi-device collective/sharding paths (pmean/psum/shard_map) are exercised on
 fake CPU devices — real SPMD semantics, no TPU pod needed (SURVEY.md §4).
-
-Note: this image's sitecustomize imports jax and registers the remote-TPU
-("axon") backend at interpreter startup, so env vars alone are too late —
-we must override the already-set ``jax_platforms`` config. Backends are
-instantiated lazily, so setting XLA_FLAGS here (before first device use)
-still yields 8 virtual CPU devices.
+See kfac_pytorch_tpu/platform_override.py for why env vars alone are too late
+on this image.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from kfac_pytorch_tpu.platform_override import force_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
+assert force_cpu_devices(8), "JAX backend initialized before conftest ran"
