@@ -22,6 +22,11 @@ if os.environ.get("KFAC_FORCE_PLATFORM"):  # testing escape hatch (examples/_env
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
     import _env  # noqa: F401
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

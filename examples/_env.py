@@ -24,3 +24,7 @@ if _force:
             "could not force the CPU platform — a JAX backend was already "
             "instantiated before examples/_env.py was imported"
         )
+
+from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()

@@ -31,7 +31,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kfac_pytorch_tpu import KFAC, KFACParamScheduler
 from kfac_pytorch_tpu.models import cifar_resnet
-from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.parallel import launch
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
 from kfac_pytorch_tpu.training import (
     TrainState,
     create_lr_schedule,
@@ -87,10 +88,14 @@ def main(argv=None):
     args = parse_args(argv)
     rng = np.random.RandomState(args.seed)
 
+    launch.initialize()  # multi-host wiring; no-op single-process
     mesh = data_parallel_mesh()
     world = mesh.devices.size
+    n_proc = launch.size()
     global_bs = args.batch_size * world
-    print(f"devices={world} global_batch={global_bs}")
+    local_bs = global_bs // n_proc
+    if launch.is_primary():
+        print(f"devices={world} hosts={n_proc} global_batch={global_bs}")
 
     model = cifar_resnet.get_model(args.model)
     init_images = jnp.zeros((global_bs, 32, 32, 3), jnp.float32)
@@ -138,15 +143,17 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        # hosts must agree (checkpoints may live on host-local disk and only
+        # the primary writes them; the reference broadcasts the epoch too,
+        # pytorch_imagenet_resnet.py:136-140)
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
         if resume_from_epoch and kfac_sched:
             kfac_sched.epoch = resume_from_epoch
-        if resume_from_epoch:
+        if resume_from_epoch and launch.is_primary():
             print(f"resumed from epoch {resume_from_epoch - 1}")
 
-    # replicate state, shard batches over the data axis
-    rep = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("data"))
-    state = jax.device_put(state, rep)
+    # replicate state over the mesh; batches are sharded on the data axis
+    state = jax.device_put(state, NamedSharding(mesh, P()))
 
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
@@ -162,7 +169,8 @@ def main(argv=None):
         x_train, y_train = data_lib.load_cifar10(cifar_dir, train=True)
         x_val, y_val = data_lib.load_cifar10(cifar_dir, train=False)
         steps_per_epoch = len(x_train) // global_bs
-        print(f"CIFAR-10 from {cifar_dir}: {len(x_train)} train / {len(x_val)} val")
+        if launch.is_primary():
+            print(f"CIFAR-10 from {cifar_dir}: {len(x_train)} train / {len(x_val)} val")
     else:
         if not args.synthetic:
             print("no CIFAR-10 data found; falling back to --synthetic")
@@ -178,12 +186,13 @@ def main(argv=None):
             kfac_sched.step(epoch=epoch)
         if cifar_dir:
             batches = data_lib.epoch_batches(
-                x_train, y_train, global_bs, shuffle=True, augment=True,
+                x_train, y_train, local_bs, shuffle=True, augment=True,
                 seed=args.seed + epoch,
+                num_shards=n_proc, shard_index=launch.rank(),
             )
         else:
             batches = data_lib.synthetic_batches(
-                global_bs, (32, 32, 3), 10, steps_per_epoch, seed=args.seed
+                local_bs, (32, 32, 3), 10, steps_per_epoch, seed=args.seed
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
@@ -193,10 +202,7 @@ def main(argv=None):
             lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
             damping = kfac.hparams.damping if kfac else 0.0
             flags = kfac_flags_for_step(step, kfac, epoch)
-            batch = (
-                jax.device_put(jnp.asarray(xb), shard),
-                jax.device_put(jnp.asarray(yb), shard),
-            )
+            batch = put_global_batch(mesh, (xb, yb))
             state, metrics = train_step(
                 state, batch, jnp.float32(lr), jnp.float32(damping), **flags
             )
@@ -205,28 +211,27 @@ def main(argv=None):
             acc_m.update(jax.device_get(metrics["accuracy"]))
         dt = time.perf_counter() - t0
         imgs_per_sec = steps_per_epoch * global_bs / dt
-        print(
-            f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "
-            f"lr={lr:.4f} {imgs_per_sec:.0f} img/s ({dt:.1f}s)"
-        )
+        if launch.is_primary():
+            print(
+                f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "
+                f"lr={lr:.4f} {imgs_per_sec:.0f} img/s ({dt:.1f}s)"
+            )
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/accuracy", acc_m.avg, epoch)
         writer.add_scalar("train/lr", lr, epoch)
 
         if cifar_dir:
             vl, va = Metric("val/loss"), Metric("val/accuracy")
-            val_bs = args.val_batch_size * world
+            val_bs = args.val_batch_size * world // n_proc
             for xb, yb in data_lib.epoch_batches(
-                x_val, y_val, val_bs, shuffle=False, augment=False, seed=0
+                x_val, y_val, val_bs, shuffle=False, augment=False, seed=0,
+                num_shards=n_proc, shard_index=launch.rank(),
             ):
-                vbatch = (
-                    jax.device_put(jnp.asarray(xb), shard),
-                    jax.device_put(jnp.asarray(yb), shard),
-                )
-                m = eval_step(state, vbatch)
+                m = eval_step(state, put_global_batch(mesh, (xb, yb)))
                 vl.update(jax.device_get(m["loss"]))
                 va.update(jax.device_get(m["accuracy"]))
-            print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
+            if launch.is_primary():
+                print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
             writer.add_scalar("val/loss", vl.avg, epoch)
             writer.add_scalar("val/accuracy", va.avg, epoch)
 

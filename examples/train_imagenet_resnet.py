@@ -31,7 +31,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
 from kfac_pytorch_tpu.models import imagenet_resnet
-from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.parallel import launch
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
 from kfac_pytorch_tpu.training import (
     TrainState,
     create_lr_schedule,
@@ -94,10 +95,14 @@ def _npy_shards(data_dir, split):
 def main(argv=None):
     args = parse_args(argv)
 
+    launch.initialize()  # multi-host wiring; no-op single-process
     mesh = data_parallel_mesh()
     world = mesh.devices.size
+    n_proc = launch.size()
     global_bs = args.batch_size * world
-    print(f"devices={world} global_batch={global_bs}")
+    local_bs = global_bs // n_proc
+    if launch.is_primary():
+        print(f"devices={world} hosts={n_proc} global_batch={global_bs}")
 
     model = imagenet_resnet.get_model(args.model)
     im = args.image_size
@@ -136,7 +141,10 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
-        if resume_from_epoch:
+        # all hosts must agree on the epoch (the reference broadcasts it,
+        # pytorch_imagenet_resnet.py:136-140)
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
+        if resume_from_epoch and launch.is_primary():
             print(f"resumed from epoch {resume_from_epoch - 1}")
     if use_kfac:
         # scheduler restores its position from the resume epoch
@@ -150,9 +158,7 @@ def main(argv=None):
             start_epoch=resume_from_epoch,
         )
 
-    rep = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("data"))
-    state = jax.device_put(state, rep)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
 
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
@@ -186,13 +192,15 @@ def main(argv=None):
             kfac_sched.step(epoch=epoch)
         if train_data is not None:
             x_train, y_train = train_data
+            # same seeded permutation on every host; interleaved slice per
+            # host (the DistributedSampler pattern)
             order = np.random.RandomState(args.seed + epoch).permutation(
                 len(x_train) // global_bs * global_bs
-            )
+            )[launch.rank() :: n_proc]
 
             def batches():
                 for b in range(steps_per_epoch):
-                    take = order[b * global_bs : (b + 1) * global_bs]
+                    take = order[b * local_bs : (b + 1) * local_bs]
                     yield (
                         np.asarray(x_train[take], np.float32),
                         np.asarray(y_train[take], np.int32),
@@ -201,7 +209,7 @@ def main(argv=None):
             batch_iter = batches()
         else:
             batch_iter = data_lib.synthetic_batches(
-                global_bs, (im, im, 3), 1000, steps_per_epoch, seed=args.seed
+                local_bs, (im, im, 3), 1000, steps_per_epoch, seed=args.seed
             )
 
         t0 = time.perf_counter()
@@ -211,10 +219,7 @@ def main(argv=None):
                 break
             lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
             flags = kfac_flags_for_step(step, kfac, epoch)
-            batch = (
-                jax.device_put(jnp.asarray(xb), shard),
-                jax.device_put(jnp.asarray(yb), shard),
-            )
+            batch = put_global_batch(mesh, (xb, yb))
             state, metrics = train_step(
                 state, batch, jnp.float32(lr),
                 jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
@@ -223,10 +228,11 @@ def main(argv=None):
             loss_m.update(jax.device_get(metrics["loss"]))
             acc_m.update(jax.device_get(metrics["accuracy"]))
         dt = time.perf_counter() - t0
-        print(
-            f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "
-            f"lr={lr:.4f} {steps_per_epoch * global_bs / dt:.0f} img/s"
-        )
+        if launch.is_primary():
+            print(
+                f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "
+                f"lr={lr:.4f} {steps_per_epoch * global_bs / dt:.0f} img/s"
+            )
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/accuracy", acc_m.avg, epoch)
         writer.add_scalar("train/lr", lr, epoch)
@@ -235,17 +241,16 @@ def main(argv=None):
             x_val, y_val = val_data
             vl, va = Metric("val/loss"), Metric("val/accuracy")
             val_bs = args.val_batch_size * world
+            local_val_bs = val_bs // n_proc
             for b in range(len(x_val) // val_bs):
-                xb = np.asarray(x_val[b * val_bs : (b + 1) * val_bs], np.float32)
-                yb = np.asarray(y_val[b * val_bs : (b + 1) * val_bs], np.int32)
-                vbatch = (
-                    jax.device_put(jnp.asarray(xb), shard),
-                    jax.device_put(jnp.asarray(yb), shard),
-                )
-                m = eval_step(state, vbatch)
+                lo = b * val_bs + launch.rank() * local_val_bs
+                xb = np.asarray(x_val[lo : lo + local_val_bs], np.float32)
+                yb = np.asarray(y_val[lo : lo + local_val_bs], np.int32)
+                m = eval_step(state, put_global_batch(mesh, (xb, yb)))
                 vl.update(jax.device_get(m["loss"]))
                 va.update(jax.device_get(m["accuracy"]))
-            print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
+            if launch.is_primary():
+                print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
             writer.add_scalar("val/loss", vl.avg, epoch)
             writer.add_scalar("val/accuracy", va.avg, epoch)
 

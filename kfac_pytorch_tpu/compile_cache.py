@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache setup.
+
+TPU eigh (QDWH) compiles slowly per distinct shape (minutes at n≥2048 —
+see ops/eigh.py). Shape bucketing bounds the number of compiles; this module
+makes them one-time per machine by pointing JAX's persistent compilation
+cache at a stable directory. The reference never faced this: cuSOLVER/MAGMA
+eigensolvers are shipped pre-compiled (kfac_preconditioner.py:252).
+
+Call :func:`enable_persistent_cache` BEFORE the first jit execution (import
+time is fine; the config flags only take effect at backend init).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
+
+
+def enable_persistent_cache(path: str | None = None) -> str:
+    """Enable JAX's on-disk compilation cache; returns the cache directory.
+
+    ``KFAC_COMPILE_CACHE`` overrides the default (``<repo>/.jax_cache``);
+    set it to ``0``/``off`` to disable.
+    """
+    import jax
+
+    env = os.environ.get("KFAC_COMPILE_CACHE")
+    if env in ("0", "off", "none"):
+        return ""
+    path = path or env or _DEFAULT
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything non-trivial: eigh buckets are the point, but full
+    # train-step programs (30s+ compiles) benefit just as much.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path

@@ -3,10 +3,10 @@
 TPU-native replacement for the reference's Horovod topology + round-robin
 work distribution (kfac_preconditioner.py:383-399, 410-437): assignment
 tables are computed host-side (static w.r.t. compilation), eigendecomposition
-work is sharded with ``jax.shard_map`` + ``lax.cond`` on ``axis_index``, and
-results are exchanged with a single ``psum`` of zero-masked buffers — the
-reference's "allgather via sum of zeros" trick (kfac_preconditioner.py:
-424-426) expressed as one XLA collective over ICI.
+work is shape-bucketed and sharded with ``jax.shard_map`` (each device batch-
+eigh's the slots it owns), and results are exchanged with a ``psum`` of
+zero-masked buffers — the reference's "allgather via sum of zeros" trick
+(kfac_preconditioner.py:424-426) expressed as XLA collectives over ICI.
 """
 
 from kfac_pytorch_tpu.parallel.assignment import (

@@ -11,7 +11,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 def data_parallel_mesh(
@@ -26,3 +26,21 @@ def data_parallel_mesh(
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def put_global_batch(mesh: Mesh, batch, axis_name: str = "data"):
+    """Assemble a batch-axis-sharded global array from host-local numpy data.
+
+    Single-process: a plain ``device_put`` with a ``P(axis_name)`` sharding.
+    Multi-host: each process contributes its local shard
+    (``jax.make_array_from_process_local_data``) — the device-side analog of
+    the reference feeding each rank its ``DistributedSampler`` slice. The
+    returned arrays are GLOBAL: the jitted step sees the full batch axis.
+    """
+    sharding = NamedSharding(mesh, PartitionSpec(axis_name))
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda a: jax.make_array_from_process_local_data(sharding, np.asarray(a)),
+        batch,
+    )

@@ -1,63 +1,140 @@
-"""SPMD-sharded factor eigendecomposition over a device mesh.
+"""SPMD-sharded, shape-bucketed factor eigendecomposition over a device mesh.
 
 The reference distributes per-layer eigendecompositions across Horovod ranks:
 owners compute, non-owners zero their buffers, and a Sum-allreduce reassembles
 ("allgather via sum of zeros", kfac_preconditioner.py:196-255, 421-437).
 
-The TPU-native version runs the same math inside ONE compiled program:
-``shard_map`` over the mesh axis, ``lax.cond`` on ``axis_index`` so only the
-owner device executes each (layer, block) eigh at runtime, then a single
-``psum`` per buffer reassembles results on every device. XLA schedules all
-eigh branches and the collective together — no hand-rolled async queue
-(Horovod's C++ fusion buffer) is needed.
+The TPU-native version keeps that communication pattern but re-plans the
+compute for XLA's compilation model. Every (layer, factor, diag-block) job is
+a *slot* with a static owner device (parallel/assignment.py). Slots are
+rounded up to a small set of padded shape buckets (ops/eigh.py — TPU eigh
+compile cost is per-distinct-shape and brutal above n≈1024), and inside ONE
+``shard_map`` program each device:
+
+1. gathers the padded blocks for the slots it owns into a uniform
+   ``[rows, m, m]`` stack (a static per-device index table, so the gather is
+   just ``jnp.take`` on a replicated stack),
+2. runs one batched eigh per bucket,
+3. scatter-adds its results into a zeroed all-slots buffer, and
+4. a single ``psum`` per bucket reassembles every device's slots — the
+   reference's exact sum-of-zeros exchange, riding ICI.
+
+Per-device eigh work shrinks ~1/world while the number of compiled eigh
+shapes stays at the bucket count (≤ ~6 for ResNet-50) regardless of world
+size or layer count.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kfac_pytorch_tpu.ops.eigh import eigh_with_floor, get_block_boundary
+from kfac_pytorch_tpu.ops.eigh import (
+    batched_eigh,
+    bucket_size,
+    get_block_boundary,
+    pad_for_eigh,
+    unpad_eigh,
+)
 
 Assignment = Dict[str, Dict[str, Tuple[int, ...]]]
 
 
-def _owned_blocked_eigh(
-    factor: jnp.ndarray,
-    ranks: Tuple[int, ...],
-    my_idx: jnp.ndarray,
-    eps: float,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-device contribution to one factor's (blocked) eigendecomposition.
+@dataclasses.dataclass(frozen=True)
+class EighSlot:
+    """One eigendecomposition job: a diagonal block of one layer's factor."""
 
-    Device ``ranks[i]`` computes diagonal block ``i``; everyone else
-    contributes zeros. Block count is capped at ``min(shape)``
-    (kfac_preconditioner.py:244-247). Returns zero-masked ``(Q, d)`` buffers
-    ready to be ``psum``-reassembled.
+    name: str
+    factor: str  # 'A' | 'G'
+    start: int  # block row range within the factor
+    stop: int
+    owner: int  # owning device index along the mesh axis
+
+    @property
+    def size(self) -> int:
+        return self.stop - self.start
+
+
+def build_slots(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    assignment: Optional[Assignment],
+    blocks_per_layer: Optional[Dict[str, int]] = None,
+) -> List[EighSlot]:
+    """Expand factors into per-block jobs with owners.
+
+    With an ``assignment`` table, block count and owners come from the ranks
+    tuples (block count capped at ``min(shape)`` exactly as
+    kfac_preconditioner.py:244-247). Without one (replicated mode),
+    ``blocks_per_layer`` gives the counts and device 0 owns everything.
     """
-    n_blocks = min(len(ranks), min(factor.shape))
-    q_buf = jnp.zeros_like(factor)
-    d_buf = jnp.zeros((factor.shape[0],), dtype=factor.dtype)
-    for i in range(n_blocks):
-        owner = ranks[i]
-        (r0, c0), (r1, c1) = get_block_boundary(i, n_blocks, factor.shape)
-        block = factor[r0:r1, c0:c1]
+    slots: List[EighSlot] = []
+    for name in factors:
+        for fac in ("A", "G"):
+            n = factors[name][fac].shape[0]
+            if assignment is not None:
+                owners = assignment[name][fac]
+            else:
+                owners = (0,) * (blocks_per_layer or {}).get(name, 1)
+            nb = min(len(owners), n)
+            for b in range(nb):
+                (r0, _), (r1, _) = get_block_boundary(b, nb, (n, n))
+                slots.append(EighSlot(name, fac, r0, r1, owners[b]))
+    return slots
 
-        def _compute(m):
-            return eigh_with_floor(m, eps)
 
-        def _skip(m):
-            return jnp.zeros_like(m), jnp.zeros((m.shape[0],), dtype=m.dtype)
+def _bucket_groups(
+    slots: List[EighSlot], granularity: int, minimum: int
+) -> Dict[int, List[int]]:
+    groups: Dict[int, List[int]] = {}
+    for i, s in enumerate(slots):
+        groups.setdefault(bucket_size(s.size, granularity, minimum), []).append(i)
+    return dict(sorted(groups.items()))
 
-        q_blk, d_blk = lax.cond(my_idx == owner, _compute, _skip, block)
-        q_buf = q_buf.at[r0:r1, c0:c1].set(q_blk)
-        d_buf = d_buf.at[r0:r1].set(d_blk)
-    return q_buf, d_buf
+
+def _padded_stack(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    slots: List[EighSlot],
+    idxs: List[int],
+    m: int,
+) -> jnp.ndarray:
+    rows = []
+    for i in idxs:
+        s = slots[i]
+        f = factors[s.name][s.factor]
+        blk = f[s.start : s.stop, s.start : s.stop].astype(jnp.float32)
+        rows.append(pad_for_eigh(0.5 * (blk + blk.T), m))
+    return jnp.stack(rows)
+
+
+def _assemble(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    slots: List[EighSlot],
+    results: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]],
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Scatter per-slot (Q, d) into per-layer block-diagonal eigen buffers."""
+    eigen: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for name, f in factors.items():
+        na, ng = f["A"].shape[0], f["G"].shape[0]
+        eigen[name] = {
+            "QA": jnp.zeros((na, na), jnp.float32),
+            "dA": jnp.zeros((na,), jnp.float32),
+            "QG": jnp.zeros((ng, ng), jnp.float32),
+            "dG": jnp.zeros((ng,), jnp.float32),
+        }
+    for i, s in enumerate(slots):
+        q, d = results[i]
+        qk, dk = ("QA", "dA") if s.factor == "A" else ("QG", "dG")
+        eigen[s.name][qk] = (
+            eigen[s.name][qk].at[s.start : s.stop, s.start : s.stop].set(q)
+        )
+        eigen[s.name][dk] = eigen[s.name][dk].at[s.start : s.stop].set(d)
+    return eigen
 
 
 def sharded_eigen_update(
@@ -66,16 +143,30 @@ def sharded_eigen_update(
     mesh: Mesh,
     axis_name: str = "data",
     eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Recompute all layers' eigendecompositions, sharded over ``axis_name``.
 
     ``factors`` is the replicated ``{layer: {'A', 'G'}}`` dict; returns the
-    replicated ``{layer: {'QA', 'dA', 'QG', 'dG'}}`` dict. Work placement
-    follows ``assignment`` (see parallel/assignment.py). State is rebuilt
-    from zeros every update, so the reference's ``_clear_eigen`` off-diagonal
-    clearing at diag_blocks transitions (kfac_preconditioner.py:167-178,
-    375-381) is unnecessary by construction.
+    replicated ``{layer: {'QA', 'dA', 'QG', 'dG'}}`` dict with work placed
+    per ``assignment`` (see module docstring for the SPMD plan).
     """
+    world = mesh.devices.size
+    slots = build_slots(factors, assignment)
+    groups = _bucket_groups(slots, granularity, minimum)
+
+    # Host-side per-bucket index tables: device -> the stack rows it owns.
+    tables = {}
+    for m, idxs in groups.items():
+        owned = [[r for r, i in enumerate(idxs) if slots[i].owner == dev] for dev in range(world)]
+        rows = max(1, max(len(o) for o in owned))
+        idx_tab = [(o + [0] * (rows - len(o))) for o in owned]
+        valid = [[1.0] * len(o) + [0.0] * (rows - len(o)) for o in owned]
+        tables[m] = (
+            jnp.asarray(idx_tab, jnp.int32),
+            jnp.asarray(valid, jnp.float32),
+        )
 
     @partial(
         jax.shard_map,
@@ -85,14 +176,26 @@ def sharded_eigen_update(
         check_vma=False,
     )
     def _inner(facs):
-        idx = lax.axis_index(axis_name)
-        out = {}
-        for name, f in facs.items():
-            qa, da = _owned_blocked_eigh(f["A"], assignment[name]["A"], idx, eps)
-            qg, dg = _owned_blocked_eigh(f["G"], assignment[name]["G"], idx, eps)
-            out[name] = {"QA": qa, "dA": da, "QG": qg, "dG": dg}
-        # one psum per buffer reassembles every (layer, block) result
-        return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), out)
+        dev = lax.axis_index(axis_name)
+        per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        for m, idxs in groups.items():
+            all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
+            idx_tab, valid = tables[m]
+            mine = jnp.take(idx_tab, dev, axis=0)  # [rows]
+            vmask = jnp.take(valid, dev, axis=0)  # [rows]
+            stack = jnp.take(all_blocks, mine, axis=0)  # [rows, m, m]
+            q, d = batched_eigh(stack)
+            q = q * vmask[:, None, None]
+            d = d * vmask[:, None]
+            k = len(idxs)
+            # Sum-of-zeros exchange: scatter-add my rows, psum the rest in.
+            kq = jnp.zeros((k, m, m), jnp.float32).at[mine].add(q)
+            kd = jnp.zeros((k, m), jnp.float32).at[mine].add(d)
+            kq = lax.psum(kq, axis_name)
+            kd = lax.psum(kd, axis_name)
+            for row, i in enumerate(idxs):
+                per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
+        return _assemble(facs, slots, per_slot)
 
     return _inner(factors)
 
@@ -101,14 +204,22 @@ def replicated_eigen_update(
     factors: Dict[str, Dict[str, jnp.ndarray]],
     diag_blocks_per_layer: Dict[str, int],
     eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
-    """Single-device / replicated fallback: every device computes all layers."""
-    from kfac_pytorch_tpu.ops.eigh import blocked_eigh
+    """Single-device path: every job computed locally, still shape-bucketed.
 
-    out = {}
-    for name, f in factors.items():
-        n = diag_blocks_per_layer.get(name, 1)
-        qa, da = blocked_eigh(f["A"], n, eps)
-        qg, dg = blocked_eigh(f["G"], n, eps)
-        out[name] = {"QA": qa, "dA": da, "QG": qg, "dG": dg}
-    return out
+    Identical math to :func:`sharded_eigen_update` with world=1 — the bucketed
+    batched eigh is what keeps single-chip ResNet-50 compile times sane.
+    """
+    from kfac_pytorch_tpu.ops.eigh import bucketed_eigh
+
+    slots = build_slots(factors, None, diag_blocks_per_layer)
+    blocks = [
+        factors[s.name][s.factor][s.start : s.stop, s.start : s.stop].astype(
+            jnp.float32
+        )
+        for s in slots
+    ]
+    results = bucketed_eigh(blocks, eps, granularity, minimum)
+    return _assemble(factors, slots, dict(enumerate(results)))

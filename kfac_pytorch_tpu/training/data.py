@@ -64,13 +64,27 @@ def epoch_batches(
     shuffle: bool,
     augment: bool,
     seed: int,
+    num_shards: int = 1,
+    shard_index: int = 0,
 ) -> Iterator[Batch]:
-    """One epoch of full batches (drops the ragged tail, like drop_last)."""
+    """One epoch of full batches (drops the ragged tail, like drop_last).
+
+    ``num_shards``/``shard_index`` give the multi-host ``DistributedSampler``
+    behavior (pytorch_cifar10_resnet.py:137-148): every host derives the SAME
+    seeded global permutation, then takes its interleaved slice, so shards
+    are disjoint and epoch-reshuffled in lockstep. ``batch_size`` is the
+    per-shard (per-host) size.
+    """
     rng = np.random.RandomState(seed)
     idx = np.arange(len(x))
     if shuffle:
         rng.shuffle(idx)
-    n_batches = len(x) // batch_size
+    # batch count from the MINIMUM shard length, so every host yields the
+    # same number of batches — a longer shard must not run an extra
+    # collective step (that deadlocks the pod)
+    n_batches = (len(x) // num_shards) // batch_size
+    if num_shards > 1:
+        idx = idx[shard_index::num_shards]
     for b in range(n_batches):
         take = idx[b * batch_size : (b + 1) * batch_size]
         xb = x[take]

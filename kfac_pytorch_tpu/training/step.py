@@ -129,13 +129,22 @@ def make_train_step(
         mutable = ["batch_stats"] if has_bn else []
 
         def loss_fn(params):
-            out = model.apply(
-                _variables(params, state.batch_stats),
-                images,
-                mutable=mutable,
-                **train_kwargs,
-            )
-            logits, mut = out if mutable else (out, {})
+            # flax returns an (out, mut) tuple for ANY mutable list, even [] —
+            # only skip the unpack when we pass no mutable arg at all
+            if mutable:
+                logits, mut = model.apply(
+                    _variables(params, state.batch_stats),
+                    images,
+                    mutable=mutable,
+                    **train_kwargs,
+                )
+            else:
+                logits, mut = (
+                    model.apply(
+                        _variables(params, state.batch_stats), images, **train_kwargs
+                    ),
+                    {},
+                )
             loss = softmax_cross_entropy(logits, labels, label_smoothing)
             return loss, (mut, logits)
 

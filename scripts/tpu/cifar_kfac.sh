@@ -1,0 +1,25 @@
+#!/bin/bash
+# CIFAR-10 ResNet-32 + K-FAC on a TPU slice — the TPU-native analog of the
+# reference's Slurm/MPI recipe (sbatch/longhorn/cifar_kfac.slurm: 1 node x
+# 4 V100, mpiexec). On TPU there is no mpiexec: one process per HOST drives
+# all local chips, and `gcloud ... tpu-vm ssh --worker=all` fans the command
+# out to every host of the slice; jax.distributed.initialize() (called by the
+# trainer via kfac_pytorch_tpu.parallel.launch) wires the hosts together.
+#
+# Single host (v5e-8 and smaller): just run the trainer directly.
+#
+# Usage:
+#   TPU_NAME=my-tpu ZONE=us-central1-a ./scripts/tpu/cifar_kfac.sh
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME}"
+ZONE="${ZONE:?set ZONE}"
+REPO_DIR="${REPO_DIR:-\$HOME/kfac_pytorch_tpu}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python examples/train_cifar10_resnet.py \
+    --base-lr 0.1 \
+    --epochs 100 \
+    --kfac-update-freq 10 \
+    --model resnet32 \
+    --lr-decay 35 75 90"

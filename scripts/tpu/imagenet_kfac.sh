@@ -1,0 +1,29 @@
+#!/bin/bash
+# ImageNet ResNet-50 + K-FAC on a TPU pod slice — the TPU-native analog of
+# the reference's 16-node x 4-V100 Slurm recipe
+# (sbatch/longhorn/imagenet_kfac.slurm:28-38), targeting v5e-64.
+#
+# Data staging: the reference copies imagenet.tar to node-local /tmp on every
+# host first (sbatch/cp_imagenet_to_temp.sh); stage_imagenet.sh is the
+# per-host equivalent here (run it with --worker=all before training).
+#
+# Usage:
+#   TPU_NAME=my-pod ZONE=us-central1-a ./scripts/tpu/imagenet_kfac.sh
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME}"
+ZONE="${ZONE:?set ZONE}"
+REPO_DIR="${REPO_DIR:-\$HOME/kfac_pytorch_tpu}"
+DATA_DIR="${DATA_DIR:-/tmp/imagenet}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python examples/train_imagenet_resnet.py \
+    --data-dir $DATA_DIR \
+    --model resnet50 \
+    --epochs 55 \
+    --batch-size 32 \
+    --base-lr 0.0125 \
+    --lr-decay 25 35 40 45 50 \
+    --kfac-update-freq 100 \
+    --kfac-cov-update-freq 10 \
+    --damping 0.001"

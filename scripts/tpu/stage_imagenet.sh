@@ -1,0 +1,17 @@
+#!/bin/bash
+# Stage ImageNet to host-local disk on every worker of a TPU pod slice — the
+# TPU analog of the reference's sbatch/cp_imagenet_to_temp.sh (which cp+untars
+# imagenet.tar to each node's /tmp). On Cloud TPU the source is a GCS bucket.
+#
+# Usage (from your workstation):
+#   TPU_NAME=my-pod ZONE=us-central1-a SRC=gs://my-bucket/imagenet \
+#     ./scripts/tpu/stage_imagenet.sh
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME}"
+ZONE="${ZONE:?set ZONE}"
+SRC="${SRC:?set SRC (gs://... path with train/ and val/)}"
+DST="${DST:-/tmp/imagenet}"
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "mkdir -p $DST && gsutil -m rsync -r $SRC $DST && echo staged: \$(hostname)"

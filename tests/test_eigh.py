@@ -96,3 +96,53 @@ def test_blocked_eigh_exact_on_block_diagonal_input():
     q, d = eigh_ops.blocked_eigh(jnp.asarray(a), 2)
     rec = np.asarray(q) @ np.diag(np.asarray(d)) @ np.asarray(q).T
     np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Shape-bucketed padded/batched eigh (the TPU compile-time design, ops/eigh.py)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_size_rounding():
+    assert eigh_ops.bucket_size(10) == 128
+    assert eigh_ops.bucket_size(128) == 128
+    assert eigh_ops.bucket_size(129) == 512
+    assert eigh_ops.bucket_size(576) == 1024
+    assert eigh_ops.bucket_size(576, granularity=256) == 768
+
+
+def test_padded_eigh_matches_direct():
+    # padding with a -1 diagonal must not perturb the true eigenpairs
+    for n, seed in ((5, 0), (17, 1), (31, 2)):
+        a = _rand_spd(n, seed=seed)
+        m = 64
+        padded = eigh_ops.pad_for_eigh(jnp.asarray(a), m)
+        q_p, d_p = eigh_ops.batched_eigh(padded[None])
+        q, d = eigh_ops.unpad_eigh(q_p[0], d_p[0], n, eps=1e-10)
+        q_ref, d_ref = eigh_ops.eigh_with_floor(jnp.asarray(a))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), atol=1e-4)
+        rec = np.asarray(q) @ np.diag(np.asarray(d)) @ np.asarray(q).T
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+
+def test_padded_eigh_rank_deficient_floor():
+    # PSD with exact zero eigenvalues: pad spectrum (-1) stays below, floor works
+    v = np.ones((6, 1), np.float32)
+    a = (v @ v.T).astype(np.float32)
+    padded = eigh_ops.pad_for_eigh(jnp.asarray(a), 16)
+    q_p, d_p = eigh_ops.batched_eigh(padded[None])
+    q, d = eigh_ops.unpad_eigh(q_p[0], d_p[0], 6, eps=1e-6)
+    d = np.asarray(d)
+    assert (d[np.abs(d) < 1e-6] == 0.0).all()
+    assert np.isclose(d.max(), 6.0, atol=1e-4)
+
+
+def test_bucketed_eigh_heterogeneous_list():
+    blocks = [jnp.asarray(_rand_spd(n, seed=n)) for n in (7, 20, 64, 130)]
+    results = eigh_ops.bucketed_eigh(blocks, granularity=128, minimum=32)
+    assert len(results) == len(blocks)
+    for (q, d), b in zip(results, blocks):
+        b = np.asarray(b)
+        assert q.shape == b.shape
+        rec = np.asarray(q) @ np.diag(np.asarray(d)) @ np.asarray(q).T
+        np.testing.assert_allclose(rec, b, atol=5e-3)
