@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, runtime
 from kfac_pytorch_tpu.models import cifar_resnet
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
@@ -57,6 +57,12 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint dir (enables save/resume)")
     p.add_argument("--model", default="resnet32", help="cifar resnet variant")
     p.add_argument("--batch-size", type=int, default=128, help="per-device train batch size")
+    p.add_argument("--batches-per-allreduce", type=int, default=1,
+                   help="gradient-accumulation microbatches per optimizer step "
+                        "(pytorch_cifar10_resnet.py:48-52)")
+    p.add_argument("--num-workers", type=int, default=4,
+                   help="native loader threads (0 = single-threaded numpy "
+                        "pipeline; pytorch_cifar10_resnet.py:118)")
     p.add_argument("--val-batch-size", type=int, default=128, help="per-device val batch size")
     p.add_argument("--epochs", type=int, default=100)
     p.add_argument("--steps-per-epoch", type=int, default=None, help="cap steps (synthetic/smoke)")
@@ -92,10 +98,14 @@ def main(argv=None):
     mesh = data_parallel_mesh()
     world = mesh.devices.size
     n_proc = launch.size()
+    accum = args.batches_per_allreduce
     global_bs = args.batch_size * world
     local_bs = global_bs // n_proc
     if launch.is_primary():
-        print(f"devices={world} hosts={n_proc} global_batch={global_bs}")
+        print(
+            f"devices={world} hosts={n_proc} global_batch={global_bs}"
+            + (f" x{accum} accum" if accum > 1 else "")
+        )
 
     model = cifar_resnet.get_model(args.model)
     init_images = jnp.zeros((global_bs, 32, 32, 3), jnp.float32)
@@ -157,7 +167,7 @@ def main(argv=None):
 
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
-        train_kwargs={"train": True},
+        train_kwargs={"train": True}, accum_steps=accum,
     )
     eval_step = make_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
@@ -165,12 +175,32 @@ def main(argv=None):
     lr_factor = create_lr_schedule(world, args.warmup_epochs, args.lr_decay)
 
     cifar_dir = None if args.synthetic else data_lib.find_cifar10(args.data_dir)
+    # host-agreement collectives — EVERY host must reach these, in this
+    # order, regardless of its local state: (1) only train on real data when
+    # every host found it (a partial mount must not desync the pod), (2) only
+    # use the native pipeline when every host can build/load it (its shuffle
+    # RNG differs from numpy's, so a split choice breaks disjoint sharding).
+    all_have_data = bool(launch.host_min(cifar_dir is not None))
+    use_native = bool(
+        launch.host_min(args.num_workers > 0 and runtime.native_available())
+    )
+    if cifar_dir and not all_have_data:
+        print(f"host {launch.rank()}: data found but other hosts lack it; using --synthetic")
+        cifar_dir = None
+    train_loader = None
     if cifar_dir:
         x_train, y_train = data_lib.load_cifar10(cifar_dir, train=True)
         x_val, y_val = data_lib.load_cifar10(cifar_dir, train=False)
-        steps_per_epoch = len(x_train) // global_bs
+        steps_per_epoch = len(x_train) // (global_bs * accum)
+        if use_native:
+            train_loader = runtime.NativeEpochLoader(
+                x_train, y_train, local_bs * accum, shuffle=True, augment=True,
+                num_shards=n_proc, shard_index=launch.rank(),
+                num_workers=args.num_workers,
+            )
         if launch.is_primary():
-            print(f"CIFAR-10 from {cifar_dir}: {len(x_train)} train / {len(x_val)} val")
+            pipe = "native" if train_loader else "numpy"
+            print(f"CIFAR-10 from {cifar_dir}: {len(x_train)} train / {len(x_val)} val ({pipe} pipeline)")
     else:
         if not args.synthetic:
             print("no CIFAR-10 data found; falling back to --synthetic")
@@ -184,15 +214,17 @@ def main(argv=None):
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
-        if cifar_dir:
+        if train_loader is not None:
+            batches = train_loader.epoch(args.seed + epoch)
+        elif cifar_dir:
             batches = data_lib.epoch_batches(
-                x_train, y_train, local_bs, shuffle=True, augment=True,
+                x_train, y_train, local_bs * accum, shuffle=True, augment=True,
                 seed=args.seed + epoch,
                 num_shards=n_proc, shard_index=launch.rank(),
             )
         else:
             batches = data_lib.synthetic_batches(
-                local_bs, (32, 32, 3), 10, steps_per_epoch, seed=args.seed
+                local_bs * accum, (32, 32, 3), 10, steps_per_epoch, seed=args.seed
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
@@ -202,7 +234,7 @@ def main(argv=None):
             lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
             damping = kfac.hparams.damping if kfac else 0.0
             flags = kfac_flags_for_step(step, kfac, epoch)
-            batch = put_global_batch(mesh, (xb, yb))
+            batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
             state, metrics = train_step(
                 state, batch, jnp.float32(lr), jnp.float32(damping), **flags
             )
@@ -210,7 +242,7 @@ def main(argv=None):
             loss_m.update(jax.device_get(metrics["loss"]))
             acc_m.update(jax.device_get(metrics["accuracy"]))
         dt = time.perf_counter() - t0
-        imgs_per_sec = steps_per_epoch * global_bs / dt
+        imgs_per_sec = steps_per_epoch * global_bs * accum / dt
         if launch.is_primary():
             print(
                 f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "

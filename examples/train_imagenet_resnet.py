@@ -58,6 +58,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     p.add_argument("--model", default="resnet50")
     p.add_argument("--batch-size", type=int, default=32, help="per-device")
+    p.add_argument("--batches-per-allreduce", type=int, default=1,
+                   help="gradient-accumulation microbatches per optimizer step "
+                        "(pytorch_imagenet_resnet.py:44-48)")
     p.add_argument("--val-batch-size", type=int, default=32)
     p.add_argument("--epochs", type=int, default=55)
     p.add_argument("--steps-per-epoch", type=int, default=None)
@@ -99,10 +102,14 @@ def main(argv=None):
     mesh = data_parallel_mesh()
     world = mesh.devices.size
     n_proc = launch.size()
+    accum = args.batches_per_allreduce
     global_bs = args.batch_size * world
     local_bs = global_bs // n_proc
     if launch.is_primary():
-        print(f"devices={world} hosts={n_proc} global_batch={global_bs}")
+        print(
+            f"devices={world} hosts={n_proc} global_batch={global_bs}"
+            + (f" x{accum} accum" if accum > 1 else "")
+        )
 
     model = imagenet_resnet.get_model(args.model)
     im = args.image_size
@@ -162,7 +169,7 @@ def main(argv=None):
 
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
-        train_kwargs={"train": True},
+        train_kwargs={"train": True}, accum_steps=accum,
     )
     eval_step = make_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
@@ -176,7 +183,7 @@ def main(argv=None):
         _npy_shards(args.data_dir, "val") if args.data_dir else None
     )
     if train_data is not None:
-        steps_per_epoch = len(train_data[0]) // global_bs
+        steps_per_epoch = len(train_data[0]) // (global_bs * accum)
     else:
         if not args.synthetic:
             print("no data found; falling back to --synthetic")
@@ -199,8 +206,9 @@ def main(argv=None):
             )[launch.rank() :: n_proc]
 
             def batches():
+                n = local_bs * accum
                 for b in range(steps_per_epoch):
-                    take = order[b * local_bs : (b + 1) * local_bs]
+                    take = order[b * n : (b + 1) * n]
                     yield (
                         np.asarray(x_train[take], np.float32),
                         np.asarray(y_train[take], np.int32),
@@ -209,7 +217,7 @@ def main(argv=None):
             batch_iter = batches()
         else:
             batch_iter = data_lib.synthetic_batches(
-                local_bs, (im, im, 3), 1000, steps_per_epoch, seed=args.seed
+                local_bs * accum, (im, im, 3), 1000, steps_per_epoch, seed=args.seed
             )
 
         t0 = time.perf_counter()
@@ -219,7 +227,7 @@ def main(argv=None):
                 break
             lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
             flags = kfac_flags_for_step(step, kfac, epoch)
-            batch = put_global_batch(mesh, (xb, yb))
+            batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
             state, metrics = train_step(
                 state, batch, jnp.float32(lr),
                 jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
@@ -231,7 +239,7 @@ def main(argv=None):
         if launch.is_primary():
             print(
                 f"epoch {epoch}: loss={loss_m.avg:.4f} acc={acc_m.avg:.4f} "
-                f"lr={lr:.4f} {steps_per_epoch * global_bs / dt:.0f} img/s"
+                f"lr={lr:.4f} {steps_per_epoch * global_bs * accum / dt:.0f} img/s"
             )
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/accuracy", acc_m.avg, epoch)

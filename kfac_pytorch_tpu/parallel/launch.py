@@ -110,6 +110,20 @@ def barrier(name: str = "barrier") -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def host_min(value: int) -> int:
+    """Minimum of a host-side int across all processes.
+
+    For decisions every host must make IDENTICALLY (e.g. whether to use the
+    native data pipeline — its shuffle RNG differs from the numpy one, so a
+    per-host choice would silently break disjoint sharding).
+    """
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    return int(np.min(multihost_utils.process_allgather(np.asarray(int(value)))))
+
+
 def broadcast_host_value(value, root: int = 0):
     """Agree on a host-side Python value across processes (the reference's
     ``hvd.broadcast`` of the resume epoch, pytorch_imagenet_resnet.py:136-140).

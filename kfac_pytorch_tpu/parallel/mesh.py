@@ -28,7 +28,7 @@ def data_parallel_mesh(
     return Mesh(np.asarray(devices), (axis_name,))
 
 
-def put_global_batch(mesh: Mesh, batch, axis_name: str = "data"):
+def put_global_batch(mesh: Mesh, batch, axis_name: str = "data", accum_steps: int = 1):
     """Assemble a batch-axis-sharded global array from host-local numpy data.
 
     Single-process: a plain ``device_put`` with a ``P(axis_name)`` sharding.
@@ -36,8 +36,21 @@ def put_global_batch(mesh: Mesh, batch, axis_name: str = "data"):
     (``jax.make_array_from_process_local_data``) — the device-side analog of
     the reference feeding each rank its ``DistributedSampler`` slice. The
     returned arrays are GLOBAL: the jitted step sees the full batch axis.
+
+    ``accum_steps > 1`` is for gradient accumulation: the flat host batch of
+    ``accum_steps·b`` samples is reshaped to ``[accum_steps, b, ...]`` with
+    the leading microbatch axis replicated (``P(None, axis_name)``), so the
+    train step's ``lax.scan`` slices microbatches without any resharding.
+    The reshape and the spec are paired here so callers cannot mismatch them.
     """
-    sharding = NamedSharding(mesh, PartitionSpec(axis_name))
+    if accum_steps > 1:
+        batch = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).reshape(accum_steps, -1, *np.shape(a)[1:]), batch
+        )
+        spec = PartitionSpec(None, axis_name)
+    else:
+        spec = PartitionSpec(axis_name)
+    sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     return jax.tree_util.tree_map(

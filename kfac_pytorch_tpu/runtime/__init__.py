@@ -1,0 +1,15 @@
+"""Native (C++) host runtime: threaded data-loading pipeline.
+
+The reference's host-side runtime is torch's C++ DataLoader worker pool
+(``num_workers=4``, pytorch_cifar10_resnet.py:114-118); this package provides
+the TPU build's native equivalent — see ``runtime/native/loader.cpp`` and the
+ctypes binding in ``runtime/loader.py``.
+"""
+
+from kfac_pytorch_tpu.runtime.loader import (
+    NativeEpochLoader,
+    native_available,
+    native_epoch_batches,
+)
+
+__all__ = ["NativeEpochLoader", "native_available", "native_epoch_batches"]

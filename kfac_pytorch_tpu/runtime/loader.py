@@ -1,0 +1,182 @@
+"""ctypes binding for the native threaded batch pipeline (native/loader.cpp).
+
+Builds the shared library with ``g++`` on first use (no pybind11 on this
+image — plain C ABI + ctypes keeps the binding dependency-free) and degrades
+gracefully: ``native_available()`` is False when no toolchain is present and
+callers fall back to the numpy pipeline in ``training/data.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "loader.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libkfacloader.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    # build to a process-unique temp path then rename: concurrent processes
+    # must never CDLL a half-written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        try:
+            lib = _load_locked()
+        except OSError:  # corrupt/stale/wrong-arch .so → rebuild once, else give up
+            lib = None
+            if _build():
+                try:
+                    lib = _load_locked()
+                except OSError:
+                    lib = None
+        if lib is None:
+            _build_failed = True
+        _lib = lib
+        return _lib
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    if not os.path.isfile(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    lib = ctypes.CDLL(_LIB)
+    lib.kl_create.restype = ctypes.c_void_p
+    lib.kl_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # x, y, n
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # batch, shards, shard_idx
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # shuffle, augment, pad
+        ctypes.c_int, ctypes.c_int,  # threads, depth
+    ]
+    lib.kl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.kl_num_batches.restype = ctypes.c_int64
+    lib.kl_num_batches.argtypes = [ctypes.c_void_p]
+    lib.kl_next.restype = ctypes.c_int
+    lib.kl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.kl_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def native_available() -> bool:
+    """True iff the native loader library is (or can be) built and loaded."""
+    return _load() is not None
+
+
+class NativeEpochLoader:
+    """Reusable epoch iterator backed by the C++ worker pool.
+
+    Mirrors ``training.data.epoch_batches`` semantics (seeded global shuffle,
+    interleaved host shards, drop-last, pad-4-crop/flip augmentation) but
+    fills batches on ``num_workers`` native threads with ``depth`` buffers of
+    lookahead, overlapping host data prep with device steps.
+    """
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        batch_size: int,
+        shuffle: bool,
+        augment: bool,
+        num_shards: int = 1,
+        shard_index: int = 0,
+        pad: int = 4,
+        num_workers: int = 4,
+        depth: int = 4,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (no C++ toolchain?)")
+        self._lib = lib
+        # own contiguous copies in the exact dtypes the C side reads
+        self._x = np.ascontiguousarray(x, np.float32)
+        self._y = np.ascontiguousarray(y, np.int32)
+        n, h, w, c = self._x.shape
+        self.batch_size = batch_size
+        self._sample_shape = (h, w, c)
+        self._ptr = lib.kl_create(
+            self._x.ctypes.data, self._y.ctypes.data, n, h, w, c,
+            batch_size, num_shards, shard_index,
+            int(shuffle), int(augment), pad, num_workers, depth,
+        )
+        if not self._ptr:
+            raise RuntimeError("kl_create failed")
+
+    def epoch(self, seed: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Start a (re)shuffled epoch and yield its batches."""
+        self._lib.kl_start_epoch(self._ptr, ctypes.c_uint64(seed & (2**64 - 1)))
+        h, w, c = self._sample_shape
+        while True:
+            xb = np.empty((self.batch_size, h, w, c), np.float32)
+            yb = np.empty((self.batch_size,), np.int32)
+            if not self._lib.kl_next(self._ptr, xb.ctypes.data, yb.ctypes.data):
+                return
+            yield xb, yb
+
+    @property
+    def num_batches(self) -> int:
+        if not self._ptr:
+            return 0
+        return int(self._lib.kl_num_batches(self._ptr))
+
+    def close(self) -> None:
+        if getattr(self, "_ptr", None):
+            self._lib.kl_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_epoch_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    shuffle: bool,
+    augment: bool,
+    seed: int,
+    num_shards: int = 1,
+    shard_index: int = 0,
+    num_workers: int = 4,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """One-shot epoch with the native pipeline (epoch_batches signature)."""
+    loader = NativeEpochLoader(
+        x, y, batch_size, shuffle, augment,
+        num_shards=num_shards, shard_index=shard_index, num_workers=num_workers,
+    )
+    try:
+        yield from loader.epoch(seed)
+    finally:
+        loader.close()

@@ -1,0 +1,221 @@
+// Native threaded batch pipeline — the TPU build's equivalent of the
+// reference's torch DataLoader C++ worker pool (num_workers=4,
+// pytorch_cifar10_resnet.py:118,137-148): seeded global shuffle,
+// DistributedSampler-style interleaved sharding, pad-k random crop +
+// horizontal flip augmentation, and a bounded ring of pre-filled batch
+// buffers produced by a worker pool so host-side data prep overlaps device
+// steps.
+//
+// Determinism: the epoch permutation is a Fisher–Yates driven by
+// splitmix64(seed), and per-sample augmentation parameters derive from
+// (seed, position-in-epoch) — results are byte-identical for any thread
+// count. The Python wrapper (kfac_pytorch_tpu/runtime/loader.py) binds this
+// via ctypes; build with:  g++ -O3 -shared -fPIC -pthread loader.cpp
+//
+// C ABI:
+//   kl_create(...)            -> opaque loader
+//   kl_start_epoch(p, seed)   -> shuffle + spawn workers
+//   kl_num_batches(p)         -> batches per epoch (per shard)
+//   kl_next(p, out_x, out_y)  -> 1 and fills out buffers, or 0 at epoch end
+//   kl_destroy(p)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Loader {
+  // dataset (borrowed pointers — the Python side keeps the arrays alive)
+  const float* x = nullptr;
+  const int32_t* y = nullptr;
+  int64_t n = 0;
+  int h = 0, w = 0, c = 0;
+  int batch = 0;
+  int num_shards = 1, shard_index = 0;
+  bool shuffle = false, augment = false;
+  int pad = 4;
+  int threads = 4, depth = 4;
+
+  // epoch state
+  uint64_t seed = 0;
+  std::vector<int64_t> order;  // this shard's sample indices, epoch order
+  int64_t n_batches = 0;
+
+  // ring of batch slots
+  struct Slot {
+    std::vector<float> xs;
+    std::vector<int32_t> ys;
+    int64_t ready_for = -1;  // batch index this slot holds, -1 = empty
+  };
+  std::vector<Slot> slots;
+  std::atomic<int64_t> next_claim{0};
+  int64_t next_consume = 0;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::vector<std::thread> pool;
+  bool stopping = false;
+
+  int64_t sample_bytes() const { return int64_t(h) * w * c; }
+
+  void fill_batch(int64_t b, float* out_x, int32_t* out_y) {
+    const int64_t spp = sample_bytes();
+    const int side = 2 * pad + 1;
+    for (int i = 0; i < batch; i++) {
+      const int64_t pos = b * batch + i;           // position in epoch order
+      const int64_t src = order[pos];
+      out_y[i] = y[src];
+      const float* sx = x + src * spp;
+      float* dx = out_x + int64_t(i) * spp;
+      if (!augment) {
+        std::memcpy(dx, sx, spp * sizeof(float));
+        continue;
+      }
+      uint64_t s = seed ^ (0xd1b54a32d192ed03ULL + uint64_t(pos) * 0x9e3779b97f4a7c15ULL);
+      uint64_t r = splitmix64(s);
+      const int dy = int(r % side) - pad;          // crop offset in [-pad, pad]
+      const int dxo = int((r >> 16) % side) - pad;
+      const bool flip = ((r >> 32) & 1) != 0;
+      for (int row = 0; row < h; row++) {
+        const int sr = row + dy;
+        float* drow = dx + int64_t(row) * w * c;
+        if (sr < 0 || sr >= h) {
+          std::memset(drow, 0, size_t(w) * c * sizeof(float));
+          continue;
+        }
+        for (int col = 0; col < w; col++) {
+          const int sc = (flip ? (w - 1 - col) : col) + dxo;
+          float* dpix = drow + int64_t(col) * c;
+          if (sc < 0 || sc >= w) {
+            std::memset(dpix, 0, size_t(c) * sizeof(float));
+          } else {
+            std::memcpy(dpix, sx + (int64_t(sr) * w + sc) * c, size_t(c) * sizeof(float));
+          }
+        }
+      }
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      const int64_t b = next_claim.fetch_add(1);
+      if (b >= n_batches) return;
+      Slot& slot = slots[b % depth];
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // wait until the consumer has drained whatever lived in this slot
+        cv_free.wait(lk, [&] { return stopping || b - next_consume < depth; });
+        if (stopping) return;
+      }
+      fill_batch(b, slot.xs.data(), slot.ys.data());
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot.ready_for = b;
+      }
+      cv_ready.notify_all();
+    }
+  }
+
+  void stop_pool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_free.notify_all();
+    for (auto& t : pool) t.join();
+    pool.clear();
+    stopping = false;
+  }
+
+  void start_epoch(uint64_t s) {
+    stop_pool();
+    seed = s;
+    // same seeded GLOBAL permutation on every host, then this host's
+    // interleaved slice (the DistributedSampler pattern); batch count from
+    // the minimum shard so all hosts step in lockstep.
+    std::vector<int64_t> global(n);
+    for (int64_t i = 0; i < n; i++) global[i] = i;
+    if (shuffle) {
+      uint64_t st = seed ^ 0x2545f4914f6cdd1dULL;
+      for (int64_t i = n - 1; i > 0; i--) {
+        const int64_t j = int64_t(splitmix64(st) % uint64_t(i + 1));
+        std::swap(global[i], global[j]);
+      }
+    }
+    order.clear();
+    for (int64_t i = shard_index; i < n; i += num_shards) order.push_back(global[i]);
+    n_batches = (n / num_shards) / batch;
+    for (auto& slot : slots) slot.ready_for = -1;
+    next_claim.store(0);
+    next_consume = 0;
+    const int nt = std::max(1, threads);
+    for (int t = 0; t < nt; t++) pool.emplace_back([this] { worker(); });
+  }
+
+  int next(float* out_x, int32_t* out_y) {
+    if (next_consume >= n_batches) return 0;
+    const int64_t b = next_consume;
+    Slot& slot = slots[b % depth];
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_ready.wait(lk, [&] { return slot.ready_for == b; });
+    }
+    std::memcpy(out_x, slot.xs.data(), size_t(batch) * sample_bytes() * sizeof(float));
+    std::memcpy(out_y, slot.ys.data(), size_t(batch) * sizeof(int32_t));
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      slot.ready_for = -1;
+      next_consume = b + 1;
+    }
+    cv_free.notify_all();
+    return 1;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kl_create(const float* x, const int32_t* y, int64_t n, int h, int w, int c,
+                int batch, int num_shards, int shard_index, int shuffle,
+                int augment, int pad, int threads, int depth) {
+  if (!x || !y || n <= 0 || batch <= 0 || num_shards <= 0 || depth <= 0) return nullptr;
+  auto* L = new Loader();
+  L->x = x; L->y = y; L->n = n; L->h = h; L->w = w; L->c = c;
+  L->batch = batch; L->num_shards = num_shards; L->shard_index = shard_index;
+  L->shuffle = shuffle != 0; L->augment = augment != 0; L->pad = pad;
+  L->threads = threads; L->depth = depth;
+  L->slots.resize(depth);
+  for (auto& s : L->slots) {
+    s.xs.resize(size_t(batch) * L->sample_bytes());
+    s.ys.resize(batch);
+  }
+  return L;
+}
+
+void kl_start_epoch(void* p, uint64_t seed) { static_cast<Loader*>(p)->start_epoch(seed); }
+
+int64_t kl_num_batches(void* p) { return static_cast<Loader*>(p)->n_batches; }
+
+int kl_next(void* p, float* out_x, int32_t* out_y) {
+  return static_cast<Loader*>(p)->next(out_x, out_y);
+}
+
+void kl_destroy(void* p) {
+  auto* L = static_cast<Loader*>(p);
+  L->stop_pool();
+  delete L;
+}
+
+}  // extern "C"

@@ -22,6 +22,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
@@ -83,6 +84,7 @@ def make_train_step(
     kfac: Optional[KFAC] = None,
     label_smoothing: float = 0.0,
     train_kwargs: Optional[dict] = None,
+    accum_steps: int = 1,
 ):
     """Build the jitted train step.
 
@@ -91,17 +93,25 @@ def make_train_step(
     scalars; the two flags are static (compile-cached per combination).
     With ``kfac=None`` this is the plain-SGD baseline path (the reference's
     ``--kfac-update-freq 0`` mode, pytorch_cifar10_resnet.py:169).
+
+    ``accum_steps > 1`` is gradient accumulation (the reference's
+    ``--batches-per-allreduce`` sub-batch loop, pytorch_cifar10_resnet.py:
+    225-235): the batch arrives with a leading ``[accum_steps, ...]``
+    microbatch axis (sharded ``P(None, 'data')``), grads are averaged over a
+    ``lax.scan`` of microbatches, and — matching the reference, whose hooks
+    overwrite ``m_a``/``m_g`` every forward — K-FAC statistics come from the
+    LAST microbatch only.
     """
     train_kwargs = dict(train_kwargs or {})
 
-    def loss_and_grads_captured(state, images, labels):
+    def loss_and_grads_captured(params, batch_stats, images, labels):
         perts = capture.perturbation_zeros(model, images, **train_kwargs)
-        has_bn = bool(state.batch_stats)
+        has_bn = bool(batch_stats)
         mutable = (["batch_stats"] if has_bn else []) + [KFAC_ACTS]
 
         def loss_fn(params, perts):
             out = model.apply(
-                _variables(params, state.batch_stats, {PERTURBATIONS: perts}),
+                _variables(params, batch_stats, {PERTURBATIONS: perts}),
                 images,
                 mutable=mutable,
                 **train_kwargs,
@@ -112,7 +122,7 @@ def make_train_step(
 
         (loss, (mut, logits)), (grads, gperts) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
-        )(state.params, perts)
+        )(params, perts)
         if kfac is not None and kfac.layers is not None:
             names = kfac.layers
         else:
@@ -121,11 +131,12 @@ def make_train_step(
         g_s = capture.g_factors(
             gperts, names, batch_averaged=kfac.batch_averaged if kfac else True
         )
-        new_bs = mut.get("batch_stats", state.batch_stats)
-        return loss, logits, grads, new_bs, a_c, g_s
+        new_bs = mut.get("batch_stats", batch_stats)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        return loss, acc, grads, new_bs, a_c, g_s
 
-    def loss_and_grads_plain(state, images, labels):
-        has_bn = bool(state.batch_stats)
+    def loss_and_grads_plain(params, batch_stats, images, labels):
+        has_bn = bool(batch_stats)
         mutable = ["batch_stats"] if has_bn else []
 
         def loss_fn(params):
@@ -133,7 +144,7 @@ def make_train_step(
             # only skip the unpack when we pass no mutable arg at all
             if mutable:
                 logits, mut = model.apply(
-                    _variables(params, state.batch_stats),
+                    _variables(params, batch_stats),
                     images,
                     mutable=mutable,
                     **train_kwargs,
@@ -141,7 +152,7 @@ def make_train_step(
             else:
                 logits, mut = (
                     model.apply(
-                        _variables(params, state.batch_stats), images, **train_kwargs
+                        _variables(params, batch_stats), images, **train_kwargs
                     ),
                     {},
                 )
@@ -149,10 +160,46 @@ def make_train_step(
             return loss, (mut, logits)
 
         (loss, (mut, logits)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
+            params
         )
-        new_bs = mut.get("batch_stats", state.batch_stats)
-        return loss, logits, grads, new_bs, None, None
+        new_bs = mut.get("batch_stats", batch_stats)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        return loss, acc, grads, new_bs, None, None
+
+    def accum_loss_and_grads(state, images, labels, capture_stats):
+        # images/labels: [accum_steps, microbatch, ...]; BN stats thread
+        # sequentially through microbatches like the reference's sub-batch
+        # forwards; the tail microbatch runs the capture path when needed.
+        head = accum_steps - 1 if capture_stats else accum_steps
+
+        def body(carry, xs):
+            bs, gsum, lsum, asum = carry
+            im, lb = xs
+            loss, acc, grads, new_bs, _, _ = loss_and_grads_plain(
+                state.params, bs, im, lb
+            )
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (new_bs, gsum, lsum + loss, asum + acc), None
+
+        carry = (
+            state.batch_stats,
+            jax.tree_util.tree_map(jnp.zeros_like, state.params),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+        )
+        (bs, gsum, lsum, asum), _ = lax.scan(
+            body, carry, (images[:head], labels[:head])
+        )
+        a_c = g_s = None
+        if capture_stats:
+            loss, acc, grads, bs, a_c, g_s = loss_and_grads_captured(
+                state.params, bs, images[-1], labels[-1]
+            )
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            lsum, asum = lsum + loss, asum + acc
+        inv = 1.0 / accum_steps
+        grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+        return lsum * inv, asum * inv, grads, bs, a_c, g_s
 
     def train_step(
         state: TrainState,
@@ -166,13 +213,17 @@ def make_train_step(
     ):
         images, labels = batch
         capture_stats = kfac is not None and update_factors
-        if capture_stats:
-            loss, logits, grads, new_bs, a_c, g_s = loss_and_grads_captured(
-                state, images, labels
+        if accum_steps > 1:
+            loss, acc, grads, new_bs, a_c, g_s = accum_loss_and_grads(
+                state, images, labels, capture_stats
+            )
+        elif capture_stats:
+            loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_captured(
+                state.params, state.batch_stats, images, labels
             )
         else:
-            loss, logits, grads, new_bs, a_c, g_s = loss_and_grads_plain(
-                state, images, labels
+            loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_plain(
+                state.params, state.batch_stats, images, labels
             )
 
         kfac_state = state.kfac_state
@@ -193,12 +244,7 @@ def make_train_step(
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(state.params, updates)
 
-        metrics = {
-            "loss": loss,
-            "accuracy": jnp.mean(
-                (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
-            ),
-        }
+        metrics = {"loss": loss, "accuracy": acc}
         new_state = TrainState(
             step=state.step + 1,
             params=params,
