@@ -181,8 +181,13 @@ def main(argv=None):
     # use the native pipeline when every host can build/load it (its shuffle
     # RNG differs from numpy's, so a split choice breaks disjoint sharding).
     all_have_data = bool(launch.host_min(cifar_dir is not None))
+    # all_have_data is already host-agreed, so the short-circuit below is
+    # consistent across hosts — and skips the (slow) native-lib build on
+    # synthetic/no-data runs that would never use it.
     use_native = bool(
-        launch.host_min(args.num_workers > 0 and runtime.native_available())
+        launch.host_min(
+            all_have_data and args.num_workers > 0 and runtime.native_available()
+        )
     )
     if cifar_dir and not all_have_data:
         print(f"host {launch.rank()}: data found but other hosts lack it; using --synthetic")

@@ -1,0 +1,114 @@
+"""Decoder-only transformer LM with K-FAC capture + pluggable attention.
+
+The long-context model family: beyond the reference's RNN LM (its only
+sequence workload, truncated BPTT within DP — SURVEY.md §5), this model
+composes with the sequence-parallel attention in ``parallel/context.py``:
+pass ``attention_fn=make_context_parallel_attention(mesh, ...)`` to shard
+attention over a ``seq`` mesh axis (ring or Ulysses), while every projection
+stays an ordinary capture-aware ``KFACDense`` — so the transformer trains
+under the SAME distributed K-FAC preconditioner as the CNN zoos (QKV/out/MLP
+and the decoder head are preconditioned; embeddings and LayerNorms are
+SGD-trained, the ``known_modules`` contract of kfac_preconditioner.py:103).
+
+Dropout defaults to 0.0 so the model runs under the shared
+``training.step.make_train_step`` without RNG plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.parallel.context import full_attention
+
+AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v, causal=...) -> out
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: attn + MLP residuals, all projections K-FAC-aware."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    attention_fn: AttentionFn = full_attention
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        b, t, _ = x.shape
+        hd = self.d_model // self.n_heads
+
+        h = nn.LayerNorm(name="ln_attn")(x)
+        qkv = KFACDense(3 * self.d_model, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, self.n_heads, hd)
+        a = self.attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                              causal=True)
+        a = a.reshape(b, t, self.d_model)
+        a = KFACDense(self.d_model, name="out")(a)
+        if self.dropout:
+            a = nn.Dropout(self.dropout, deterministic=not train)(a)
+        x = x + a
+
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        f = KFACDense(self.d_ff, name="ff1")(h)
+        f = nn.gelu(f)
+        f = KFACDense(self.d_model, name="ff2")(f)
+        if self.dropout:
+            f = nn.Dropout(self.dropout, deterministic=not train)(f)
+        return x + f
+
+
+class TransformerLM(nn.Module):
+    """Token + learned-position embeddings → N blocks → LN → K-FAC decoder."""
+
+    vocab_size: int
+    max_len: int = 512
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: Optional[int] = None
+    attention_fn: AttentionFn = full_attention
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
+            jnp.arange(t)[None, :]
+        )
+        x = x + pos
+        for i in range(self.n_layers):
+            x = TransformerBlock(
+                d_model=self.d_model,
+                n_heads=self.n_heads,
+                d_ff=self.d_ff or 4 * self.d_model,
+                attention_fn=self.attention_fn,
+                dropout=self.dropout,
+                name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return KFACDense(self.vocab_size, name="decoder")(x)
+
+
+def get_model(
+    vocab_size: int,
+    max_len: int = 512,
+    d_model: int = 256,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    attention_fn: AttentionFn = full_attention,
+    dropout: float = 0.0,
+) -> TransformerLM:
+    """Factory in the style of the other zoos (models/__init__.py)."""
+    return TransformerLM(
+        vocab_size=vocab_size, max_len=max_len, d_model=d_model,
+        n_heads=n_heads, n_layers=n_layers, attention_fn=attention_fn,
+        dropout=dropout,
+    )

@@ -13,6 +13,12 @@ from kfac_pytorch_tpu.parallel.assignment import (
     RoundRobin,
     layer_assignment,
 )
+from kfac_pytorch_tpu.parallel.context import (
+    full_attention,
+    make_context_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
 from kfac_pytorch_tpu.parallel.sharded_eigh import sharded_eigen_update
 
@@ -21,4 +27,8 @@ __all__ = [
     "layer_assignment",
     "data_parallel_mesh",
     "sharded_eigen_update",
+    "full_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "make_context_parallel_attention",
 ]

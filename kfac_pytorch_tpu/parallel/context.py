@@ -1,0 +1,161 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Long-context scaling is out of the reference's capability set (SURVEY.md §5 —
+its only sequence workload is truncated-BPTT within DP), but it is first-class
+here: attention over sequences longer than one chip's HBM is sharded over a
+``seq`` mesh axis two ways, both composing with the data-parallel axis and the
+K-FAC capture machinery (dense projections stay ordinary KFACDense layers —
+factor statistics reduce over the global sharded batch like every other
+layer's):
+
+* **Ring attention** — K/V shards rotate around the ``seq`` axis ring with
+  ``lax.ppermute`` (ICI neighbor hops) while each device folds one block per
+  step into a numerically-stable online softmax (running max / normalizer,
+  the flash-attention recurrence). Memory per device is O(T_local·T_local)
+  per step; full T×T logits never materialize anywhere.
+
+* **Ulysses (all-to-all)** — ``lax.all_to_all`` reshards [B, T/P, H, D] →
+  [B, T, H/P, D], runs exact attention over the FULL sequence on each
+  device's head slice, and reshards back. Two collectives, lower latency on
+  small worlds; requires heads % world == 0.
+
+Both are exact (tested against full attention to f32 tolerance) and causal-
+masking aware, using global token positions derived from ``axis_index``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+
+
+def full_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+) -> jnp.ndarray:
+    """Exact softmax attention, [B, T, H, D] → [B, T, H, D] (the reference
+    semantics ring/Ulysses must reproduce; also the single-device path)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    if causal:
+        t, s = q.shape[1], k.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(s)[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Blockwise ring attention over sequence shards (call inside shard_map).
+
+    Args are LOCAL shards [B, T_local, H, D] of a sequence sharded over
+    ``axis_name``. K/V travel the ring via ``ppermute`` (W-1 neighbor hops);
+    the online-softmax carry (running max m, normalizer l, accumulator) makes
+    each block fold exact regardless of arrival order.
+    """
+    world = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = me * t + jnp.arange(t)  # global positions of my queries
+
+    def fold(carry, s):
+        m, l, acc, kb, vb = carry
+        # kb/vb currently hold the shard that STARTED on device (me - s) % W
+        src = (me - s) % world
+        k_pos = src * t + jnp.arange(t)
+        logits = jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhts,bshd->bhtd", p, vb.astype(jnp.float32)
+        )
+        kb, vb = lax.ppermute(
+            (kb, vb), axis_name, perm=[(j, (j + 1) % world) for j in range(world)]
+        )
+        return (m_new, l, acc, kb, vb), None
+
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(
+        fold, (m0, l0, acc0, k, v), jnp.arange(world)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, T, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (call inside shard_map).
+
+    Reshards sequence shards [B, T/P, H, D] into head shards [B, T, H/P, D]
+    with one ``all_to_all``, runs EXACT full-sequence attention on the local
+    heads, and reshards back. Requires ``H % world == 0``.
+    """
+    world = lax.psum(1, axis_name)
+    if q.shape[2] % world != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({world}); use ring attention otherwise"
+        )
+
+    def to_heads(x):  # [B, T/P, H, D] -> [B, T, H/P, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = full_attention(qh, kh, vh, causal=causal)
+    # [B, T, H/P, D] -> [B, T/P, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_context_parallel_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = "data",
+    kind: str = "ring",
+):
+    """Attention fn over GLOBAL [B, T, H, D] arrays, sharded T-wise.
+
+    Returns ``attn(q, k, v, causal=True)`` that shard_maps :func:`ring_attention`
+    (or :func:`ulysses_attention`) over ``seq_axis`` — drop-in for
+    :func:`full_attention` in a model running under jit on ``mesh`` (e.g.
+    ``TransformerLM(attention_fn=...)``), composing sequence parallelism with
+    the data axis.
+    """
+    inner = {"ring": ring_attention, "ulysses": ulysses_attention}[kind]
+    spec = P(batch_axis, seq_axis, None, None)
+
+    def attn(q, k, v, causal: bool = True):
+        f = partial(inner, axis_name=seq_axis, causal=causal)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn

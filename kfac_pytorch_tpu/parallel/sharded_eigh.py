@@ -152,7 +152,10 @@ def sharded_eigen_update(
     replicated ``{layer: {'QA', 'dA', 'QG', 'dG'}}`` dict with work placed
     per ``assignment`` (see module docstring for the SPMD plan).
     """
-    world = mesh.devices.size
+    # Shard over `axis_name` only; on a multi-axis mesh the work is
+    # replicated across the other axes (their shards all hold the same
+    # factors and compute the same slots).
+    world = mesh.shape[axis_name]
     slots = build_slots(factors, assignment)
     groups = _bucket_groups(slots, granularity, minimum)
 

@@ -149,9 +149,13 @@ class KFAC:
         return names, is_conv
 
     def _world(self) -> int:
+        # Size of the eigendecomposition-sharding axis ONLY: on a multi-axis
+        # mesh (e.g. data×seq) work shards over `axis_name` and is replicated
+        # across the other axes — owners must span exactly the values
+        # lax.axis_index(axis_name) takes inside sharded_eigen_update.
         if self.mesh is None:
             return 1
-        return self.mesh.devices.size
+        return self.mesh.shape[self.axis_name]
 
     # ------------------------------------------------------------------
     # State

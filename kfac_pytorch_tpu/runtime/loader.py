@@ -133,6 +133,8 @@ class NativeEpochLoader:
 
     def epoch(self, seed: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Start a (re)shuffled epoch and yield its batches."""
+        if not self._ptr:
+            raise RuntimeError("NativeEpochLoader is closed")
         self._lib.kl_start_epoch(self._ptr, ctypes.c_uint64(seed & (2**64 - 1)))
         h, w, c = self._sample_shape
         while True:
