@@ -32,11 +32,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _timeit(step, state, warmup=2, iters=8):
+def _log(msg: str) -> None:
+    """Timestamped progress to stderr — a killed/timed-out run must still
+    show how far it got (first TPU compile can take minutes via the tunnel)."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def _timeit(step, state, warmup=2, iters=8, label=""):
     """Time a state-threading step (the step donates and returns state)."""
+    _log(f"{label}: compiling/warmup ...")
     for _ in range(warmup):
         state = step(state)
         jax.block_until_ready(state)
+    _log(f"{label}: timing {iters} iters")
     t0 = time.perf_counter()
     for _ in range(iters):
         state = step(state)
@@ -53,6 +64,7 @@ def main():
     size = int(sys.argv[sys.argv.index("--image-size") + 1]) if "--image-size" in sys.argv else 224
     fac_freq, kfac_freq = 10, 100  # reference ImageNet schedule
 
+    _log(f"device={jax.devices()[0]} batch={batch} image={size}")
     model = imagenet_resnet.get_model("resnet50")
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32))
@@ -94,14 +106,16 @@ def main():
             return s
         return _step
 
-    t_sgd, _ = _timeit(run_sgd, fresh_state(None))
+    t_sgd, _ = _timeit(run_sgd, fresh_state(None), label="sgd")
     print(f"sgd step: {t_sgd*1e3:.1f} ms ({batch/t_sgd:.1f} img/s)", file=sys.stderr)
 
     # populate eigen state once so the plain variant preconditions real factors
+    _log("kfac: compiling full (factors+eigen) step ...")
     s_kfac = run_kfac(True, True)(fresh_state(kfac))
-    t_plain, s_kfac = _timeit(run_kfac(False, False), s_kfac)
-    t_fac, s_kfac = _timeit(run_kfac(True, False), s_kfac)
-    t_full, s_kfac = _timeit(run_kfac(True, True), s_kfac, warmup=1, iters=3)
+    t_plain, s_kfac = _timeit(run_kfac(False, False), s_kfac, label="kfac precond-only")
+    t_fac, s_kfac = _timeit(run_kfac(True, False), s_kfac, label="kfac +factors")
+    t_full, s_kfac = _timeit(run_kfac(True, True), s_kfac, warmup=1, iters=3,
+                             label="kfac +eigen")
     print(
         f"kfac steps: precond-only {t_plain*1e3:.1f} ms, +factors "
         f"{t_fac*1e3:.1f} ms, +eigen {t_full*1e3:.1f} ms",

@@ -1,0 +1,203 @@
+"""Transformer LM training with distributed K-FAC + sequence parallelism.
+
+The long-context application: a decoder-only transformer whose dense
+projections train under the same distributed K-FAC preconditioner as the CNN
+examples, with attention either replicated (``--seq-parallel 1``) or sharded
+over a ``seq`` mesh axis via ring attention / Ulysses all-to-all
+(``--seq-parallel N --attention ring|ulysses``, parallel/context.py). The
+device mesh is data×seq; batch shards over ``data``, sequence over ``seq``.
+
+Synthetic smoke:
+    python examples/train_transformer_lm.py --synthetic --epochs 1 \
+        --steps-per-epoch 20 --seq-parallel 4 --attention ring
+WikiText (word-level, wiki.train.tokens layout):
+    python examples/train_transformer_lm.py --data-dir /path/to/wikitext-2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import _env  # noqa: F401  (platform forcing — must precede jax use)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
+from kfac_pytorch_tpu.models import transformer_lm
+from kfac_pytorch_tpu.parallel import launch
+from kfac_pytorch_tpu.parallel.context import (
+    full_attention,
+    make_context_parallel_attention,
+)
+from kfac_pytorch_tpu.training import checkpoint as ckpt
+from kfac_pytorch_tpu.training import data as data_lib
+from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    kfac_flags_for_step,
+    make_sgd,
+    make_train_step,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Transformer-LM K-FAC Example (TPU/JAX)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--data-dir", default=None, help="WikiText token dir")
+    p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--log-dir", default="./logs")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--seq-len", type=int, default=128, help="tokens per sample")
+    p.add_argument("--batch-size", type=int, default=8, help="per data-mesh-slot")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--steps-per-epoch", type=int, default=None)
+    p.add_argument("--base-lr", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=1e-5)
+    p.add_argument("--grad-clip", type=float, default=0.25)
+    # parallelism: seq-parallel devices; remaining devices form the data axis
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="devices on the 'seq' mesh axis (1 = no sequence parallelism)")
+    p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
+    # K-FAC (same surface as the CNN trainers)
+    p.add_argument("--kfac-update-freq", type=int, default=10, help="0 disables K-FAC")
+    p.add_argument("--kfac-cov-update-freq", type=int, default=1)
+    p.add_argument("--stat-decay", type=float, default=0.95)
+    p.add_argument("--damping", type=float, default=0.003)
+    p.add_argument("--damping-alpha", type=float, default=0.5)
+    p.add_argument("--damping-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--kl-clip", type=float, default=0.001)
+    p.add_argument("--seed", type=int, default=42)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    launch.initialize()
+    devices = np.asarray(jax.devices())
+    sp = args.seq_parallel
+    if devices.size % sp != 0:
+        raise SystemExit(f"--seq-parallel {sp} must divide device count {devices.size}")
+    if args.seq_len % sp != 0:
+        raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
+    mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
+    dp = devices.size // sp
+    global_bs = args.batch_size * dp
+    if launch.is_primary():
+        print(f"mesh data={dp} seq={sp} global_batch={global_bs} seq_len={args.seq_len}")
+
+    if sp > 1:
+        attn = make_context_parallel_attention(
+            mesh, seq_axis="seq", batch_axis="data", kind=args.attention
+        )
+    else:
+        attn = full_attention
+
+    # data: WikiText token files or a Zipf-ish synthetic stream
+    wt_dir = None if args.synthetic else data_lib.find_wikitext(args.data_dir)
+    if wt_dir:
+        splits, words = data_lib.build_corpus(wt_dir)
+    else:
+        if not args.synthetic and launch.is_primary():
+            print("no WikiText data found; falling back to --synthetic")
+        splits, words = data_lib.synthetic_corpus(vocab_size=1000)
+    vocab = len(words)
+
+    model = transformer_lm.get_model(
+        vocab, max_len=args.seq_len, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, attention_fn=attn,
+    )
+    init_toks = jnp.zeros((global_bs, args.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(args.seed), init_toks, train=True)
+    params = variables["params"]
+
+    use_kfac = args.kfac_update_freq > 0
+    tx = make_sgd(momentum=args.momentum, weight_decay=args.wd)
+    kfac = None
+    kfac_sched = None
+    if use_kfac:
+        kfac = KFAC(
+            layers=capture.discover_layers(model, init_toks, train=True),
+            factor_decay=args.stat_decay,
+            damping=args.damping,
+            kl_clip=args.kl_clip,
+            fac_update_freq=args.kfac_cov_update_freq,
+            kfac_update_freq=args.kfac_update_freq,
+            mesh=mesh if devices.size > 1 else None,
+        )
+        if args.damping_schedule:
+            kfac_sched = KFACParamScheduler(
+                kfac, damping_alpha=args.damping_alpha,
+                damping_schedule=args.damping_schedule,
+            )
+
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params) if kfac else None,
+    )
+    resume_from_epoch = 0
+    if args.checkpoint_dir:
+        state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    step_fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip
+    )
+    batch_sharding = NamedSharding(mesh, P("data", "seq"))
+
+    # [B_total, N] contiguous streams; segments of seq_len become samples
+    stream = data_lib.batchify_tokens(splits["train"], global_bs)
+    max_steps = (stream.shape[1] - 1) // args.seq_len
+    steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
+
+    writer = ScalarWriter(args.log_dir, enabled=jax.process_index() == 0)
+    step = int(jax.device_get(state.step))
+    for epoch in range(resume_from_epoch, args.epochs):
+        if kfac_sched:
+            kfac_sched.step(epoch=epoch)
+        t0 = time.perf_counter()
+        loss_m = Metric("train/loss")
+        for i in range(steps_per_epoch):
+            off = i * args.seq_len
+            toks = jnp.asarray(stream[:, off : off + args.seq_len])
+            tgts = jnp.asarray(stream[:, off + 1 : off + 1 + args.seq_len])
+            batch = jax.device_put((toks, tgts), batch_sharding)
+            flags = kfac_flags_for_step(step, kfac, epoch)
+            state, metrics = step_fn(
+                state, batch, jnp.float32(args.base_lr),
+                jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
+            )
+            step += 1
+            loss_m.update(jax.device_get(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        ppl = float(np.exp(min(loss_m.avg, 20.0)))
+        if launch.is_primary():
+            tok_s = steps_per_epoch * global_bs * args.seq_len / dt
+            print(f"epoch {epoch}: loss={loss_m.avg:.4f} ppl={ppl:.1f} {tok_s:.0f} tok/s ({dt:.1f}s)")
+        writer.add_scalar("train/loss", loss_m.avg, epoch)
+        writer.add_scalar("train/ppl", ppl, epoch)
+        if args.checkpoint_dir:
+            ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
+    writer.close()
+    return state
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,11 @@ class TransformerLM(nn.Module):
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
         b, t = tokens.shape
+        if t > self.max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds max_len {self.max_len} "
+                "(out-of-range position embeddings would be silently NaN)"
+            )
         x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(tokens)
         pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
             jnp.arange(t)[None, :]

@@ -74,10 +74,8 @@ def ring_attention(
     qf = q.astype(jnp.float32) * scale
     q_pos = me * t + jnp.arange(t)  # global positions of my queries
 
-    def fold(carry, s):
-        m, l, acc, kb, vb = carry
-        # kb/vb currently hold the shard that STARTED on device (me - s) % W
-        src = (me - s) % world
+    def fold(m, l, acc, kb, vb, src):
+        # kb/vb hold the shard that STARTED on device src
         k_pos = src * t + jnp.arange(t)
         logits = jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32))
         if causal:
@@ -90,16 +88,25 @@ def ring_attention(
         acc = acc * corr[..., None] + jnp.einsum(
             "bhts,bshd->bhtd", p, vb.astype(jnp.float32)
         )
-        kb, vb = lax.ppermute(
-            (kb, vb), axis_name, perm=[(j, (j + 1) % world) for j in range(world)]
-        )
-        return (m_new, l, acc, kb, vb), None
+        return m_new, l, acc
 
+    # fold the resident block first, then W-1 rotate-then-fold ring steps —
+    # no wasted final rotation
     m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
     acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m, l, acc = fold(m0, l0, acc0, k, v, me)
+
+    def step(carry, s):
+        m, l, acc, kb, vb = carry
+        kb, vb = lax.ppermute(
+            (kb, vb), axis_name, perm=[(j, (j + 1) % world) for j in range(world)]
+        )
+        m, l, acc = fold(m, l, acc, kb, vb, (me - s) % world)
+        return (m, l, acc, kb, vb), None
+
     (m, l, acc, _, _), _ = lax.scan(
-        fold, (m0, l0, acc0, k, v), jnp.arange(world)
+        step, (m, l, acc, k, v), jnp.arange(1, world)
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, T, D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -20,16 +20,13 @@ import optax
 from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
 from kfac_pytorch_tpu.preconditioner import KFAC
-from kfac_pytorch_tpu.training.step import TrainState, softmax_cross_entropy
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    clip_by_global_norm as _clip_by_global_norm,
+    softmax_cross_entropy,
+)
 
 PyTree = Any
-
-
-def _clip_by_global_norm(grads: PyTree, max_norm: float) -> PyTree:
-    """torch.nn.utils.clip_grad_norm_ semantics (scale if above max)."""
-    gnorm = optax.global_norm(grads)
-    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
-    return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
 
 def make_lm_train_step(

@@ -78,6 +78,13 @@ def _variables(params, batch_stats, extra=None):
     return v
 
 
+def clip_by_global_norm(grads: PyTree, max_norm: float) -> PyTree:
+    """``torch.nn.utils.clip_grad_norm_`` semantics (scale if above max)."""
+    gnorm = optax.global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -85,6 +92,7 @@ def make_train_step(
     label_smoothing: float = 0.0,
     train_kwargs: Optional[dict] = None,
     accum_steps: int = 1,
+    grad_clip: float = 0.0,
 ):
     """Build the jitted train step.
 
@@ -225,6 +233,11 @@ def make_train_step(
             loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_plain(
                 state.params, state.batch_stats, images, labels
             )
+
+        if grad_clip:
+            # between grad averaging and preconditioning, the reference's
+            # clip point (pytorch_wikitext_rnn.py:297-300)
+            grads = clip_by_global_norm(grads, grad_clip)
 
         kfac_state = state.kfac_state
         if kfac is not None:
