@@ -41,6 +41,7 @@ from kfac_pytorch_tpu.training import (
 )
 from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
+from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import kfac_flags_for_step, make_sgd
 
@@ -86,6 +87,11 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 conv/matmul compute (params + K-FAC factor "
+                        "math stay f32)")
+    p.add_argument("--profile-epoch", type=int, default=None,
+                   help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
@@ -107,7 +113,9 @@ def main(argv=None):
             + (f" x{accum} accum" if accum > 1 else "")
         )
 
-    model = cifar_resnet.get_model(args.model)
+    model = cifar_resnet.get_model(
+        args.model, dtype=jnp.bfloat16 if args.bf16 else None
+    )
     init_images = jnp.zeros((global_bs, 32, 32, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_images, train=True)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
@@ -233,19 +241,20 @@ def main(argv=None):
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
-        for i, (xb, yb) in enumerate(batches):
-            if i >= steps_per_epoch:
-                break
-            lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
-            damping = kfac.hparams.damping if kfac else 0.0
-            flags = kfac_flags_for_step(step, kfac, epoch)
-            batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
-            state, metrics = train_step(
-                state, batch, jnp.float32(lr), jnp.float32(damping), **flags
-            )
-            step += 1
-            loss_m.update(jax.device_get(metrics["loss"]))
-            acc_m.update(jax.device_get(metrics["accuracy"]))
+        with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
+            for i, (xb, yb) in enumerate(batches):
+                if i >= steps_per_epoch:
+                    break
+                lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
+                damping = kfac.hparams.damping if kfac else 0.0
+                flags = kfac_flags_for_step(step, kfac, epoch)
+                batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
+                state, metrics = train_step(
+                    state, batch, jnp.float32(lr), jnp.float32(damping), **flags
+                )
+                step += 1
+                loss_m.update(jax.device_get(metrics["loss"]))
+                acc_m.update(jax.device_get(metrics["accuracy"]))
         dt = time.perf_counter() - t0
         imgs_per_sec = steps_per_epoch * global_bs * accum / dt
         if launch.is_primary():

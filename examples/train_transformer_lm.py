@@ -36,8 +36,10 @@ from kfac_pytorch_tpu.parallel.context import (
     full_attention,
     make_context_parallel_attention,
 )
+from kfac_pytorch_tpu.parallel.mesh import put_sharded_batch
 from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
+from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import (
     TrainState,
@@ -79,6 +81,8 @@ def parse_args(argv=None):
     p.add_argument("--damping-alpha", type=float, default=0.5)
     p.add_argument("--damping-schedule", nargs="+", type=int, default=None)
     p.add_argument("--kl-clip", type=float, default=0.001)
+    p.add_argument("--profile-epoch", type=int, default=None,
+                   help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
@@ -160,7 +164,7 @@ def main(argv=None):
     step_fn = make_train_step(
         model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip
     )
-    batch_sharding = NamedSharding(mesh, P("data", "seq"))
+    batch_spec = P("data", "seq")
 
     # [B_total, N] contiguous streams; segments of seq_len become samples
     stream = data_lib.batchify_tokens(splits["train"], global_bs)
@@ -174,18 +178,26 @@ def main(argv=None):
             kfac_sched.step(epoch=epoch)
         t0 = time.perf_counter()
         loss_m = Metric("train/loss")
-        for i in range(steps_per_epoch):
-            off = i * args.seq_len
-            toks = jnp.asarray(stream[:, off : off + args.seq_len])
-            tgts = jnp.asarray(stream[:, off + 1 : off + 1 + args.seq_len])
-            batch = jax.device_put((toks, tgts), batch_sharding)
-            flags = kfac_flags_for_step(step, kfac, epoch)
-            state, metrics = step_fn(
-                state, batch, jnp.float32(args.base_lr),
-                jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
-            )
-            step += 1
-            loss_m.update(jax.device_get(metrics["loss"]))
+        with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
+            for i in range(steps_per_epoch):
+                off = i * args.seq_len
+                # numpy slices go straight to the sharded layout (multi-host
+                # safe; no device-0 staging hop)
+                batch = put_sharded_batch(
+                    mesh,
+                    (
+                        np.ascontiguousarray(stream[:, off : off + args.seq_len]),
+                        np.ascontiguousarray(stream[:, off + 1 : off + 1 + args.seq_len]),
+                    ),
+                    batch_spec,
+                )
+                flags = kfac_flags_for_step(step, kfac, epoch)
+                state, metrics = step_fn(
+                    state, batch, jnp.float32(args.base_lr),
+                    jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
+                )
+                step += 1
+                loss_m.update(jax.device_get(metrics["loss"]))
         dt = time.perf_counter() - t0
         ppl = float(np.exp(min(loss_m.avg, 20.0)))
         if launch.is_primary():

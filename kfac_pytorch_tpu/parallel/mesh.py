@@ -50,6 +50,17 @@ def put_global_batch(mesh: Mesh, batch, axis_name: str = "data", accum_steps: in
         spec = PartitionSpec(None, axis_name)
     else:
         spec = PartitionSpec(axis_name)
+    return put_sharded_batch(mesh, batch, spec)
+
+
+def put_sharded_batch(mesh: Mesh, batch, spec: PartitionSpec):
+    """Device-put host-local numpy data with an arbitrary PartitionSpec.
+
+    The general form of :func:`put_global_batch` for non-1D shardings (e.g.
+    ``P('data', 'seq')`` for sequence-parallel LM batches): single-process is
+    one ``device_put`` straight to the sharded layout (no device-0 staging
+    hop); multi-host assembles the global array from per-process shards.
+    """
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)

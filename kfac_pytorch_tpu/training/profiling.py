@@ -1,0 +1,36 @@
+"""Trace capture: one flag profiles any training epoch.
+
+The reference ships NO tracing/profiling subsystem — only wall-clock totals
+and tqdm postfixes (SURVEY.md §5). Here ``--profile-epoch N`` on the example
+CLIs wraps that epoch in a ``jax.profiler`` trace (XLA/TPU timeline, HLO op
+costs, host/device overlap), viewable in TensorBoard or Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: Optional[str], enabled: bool) -> Iterator[None]:
+    """Capture a profiler trace into ``log_dir`` when ``enabled``.
+
+    No-op (zero overhead) otherwise; degrades to a no-op with a warning if
+    the profiler backend is unavailable on this platform.
+    """
+    if not (enabled and log_dir):
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # profiler unavailable — don't kill training
+        print(f"WARNING: profiler trace unavailable: {e}")
+        yield
+        return
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

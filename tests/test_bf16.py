@@ -1,0 +1,43 @@
+"""Mixed precision: bf16 conv/matmul compute with f32 params and f32 K-FAC
+factor math (SURVEY.md §7.3.3 — eigendecompositions must stay f32).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models import cifar_resnet
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+
+def test_bf16_model_kfac_trains():
+    model = cifar_resnet.get_model("resnet20", dtype=jnp.bfloat16)
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 16, 16, 3).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=16))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params = variables["params"]
+    # params stay f32 (master weights); only compute is bf16
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree_util.tree_leaves(params)
+    )
+    kfac = KFAC(damping=0.003, fac_update_freq=1, kfac_update_freq=2)
+    tx = make_sgd(momentum=0.9)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    losses = []
+    for i in range(6):
+        state, m = step(state, (x, y), jnp.float32(0.05), jnp.float32(0.003),
+                        update_factors=True, update_eigen=i == 0)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    # factor statistics and eigen state must be f32 regardless of compute dtype
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.kfac_state)):
+        assert np.asarray(leaf).dtype in (np.float32, np.int32)
