@@ -32,10 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
 from kfac_pytorch_tpu.models import transformer_lm
 from kfac_pytorch_tpu.parallel import launch
-from kfac_pytorch_tpu.parallel.context import (
-    full_attention,
-    make_context_parallel_attention,
-)
+from kfac_pytorch_tpu.parallel.context import make_context_parallel_attention
 from kfac_pytorch_tpu.parallel.mesh import put_sharded_batch
 from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
@@ -108,7 +105,11 @@ def main(argv=None):
             mesh, seq_axis="seq", batch_axis="data", kind=args.attention
         )
     else:
-        attn = full_attention
+        # single-program attention: fused Pallas flash kernel on TPU,
+        # exact jnp elsewhere (ops/flash_attention.py)
+        from kfac_pytorch_tpu.ops.flash_attention import best_attention_fn
+
+        attn = best_attention_fn()
 
     # data: WikiText token files or a Zipf-ish synthetic stream
     wt_dir = None if args.synthetic else data_lib.find_wikitext(args.data_dir)
@@ -166,8 +167,15 @@ def main(argv=None):
     )
     batch_spec = P("data", "seq")
 
-    # [B_total, N] contiguous streams; segments of seq_len become samples
+    # [B_total, N] contiguous streams; segments of seq_len become samples.
+    # Multi-host: every process derives the same global stream, then keeps
+    # only its contiguous row block — make_array_from_process_local_data
+    # (put_sharded_batch) assembles the global batch from those shards, so
+    # no host may pass the full global batch.
     stream = data_lib.batchify_tokens(splits["train"], global_bs)
+    n_proc = launch.size()
+    rows = global_bs // n_proc
+    stream = stream[launch.rank() * rows : (launch.rank() + 1) * rows]
     max_steps = (stream.shape[1] - 1) // args.seq_len
     steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
 

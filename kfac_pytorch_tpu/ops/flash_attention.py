@@ -1,0 +1,149 @@
+"""Pallas TPU flash attention: fused blockwise softmax-attention kernel.
+
+The hot op of the transformer path (models/transformer_lm.py). XLA's naive
+attention materializes the [B, H, T, T] logits in HBM; this kernel streams
+K/V blocks through VMEM with the online-softmax recurrence (running max m,
+normalizer l, f32 accumulator), so HBM traffic is O(T·D) per head and the
+two matmuls per block ride the MXU. Same recurrence as the cross-device
+ring fold (parallel/context.py) — this is the within-chip tier of the same
+algorithm.
+
+Drop-in for ``parallel.context.full_attention`` (signature
+``(q, k, v, causal=...) -> out`` on [B, T, H, D]); auto-selected on TPU by
+``best_attention_fn()``. ``interpret=True`` runs the kernel in the Pallas
+interpreter (CPU) — that's how tests validate it without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                 causal: bool, scale: float):
+    """One (batch·head, q-block, k-block) program.
+
+    The k-block axis is the innermost grid dimension, iterated sequentially
+    per (head, q-block) — the online-softmax carry lives in VMEM scratch
+    across those revisits, so only ONE [block_k, D] K/V tile is resident at
+    a time (VMEM stays O(block) however long the sequence). Refs (leading
+    singleton = batch·head): q/o [1, block_q, D]; k/v [1, block_k, D].
+    """
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # fully-below-diagonal K blocks contribute nothing — skip their matmuls
+    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        m = m_scr[:]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kj == nk - 1)
+    def _():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused attention over [B, T, H, D] (layout of the transformer blocks).
+
+    Falls back to the exact jnp path for sequences shorter than one block —
+    the kernel's win is only at block scale anyway.
+    """
+    b, t, h, d = q.shape
+    if t % block_q or t % block_k:
+        from kfac_pytorch_tpu.parallel import context
+
+        return context.full_attention(q, k, v, causal=causal)
+    scale = 1.0 / math.sqrt(d)
+
+    # [B, T, H, D] -> [B·H, T, D] so the grid is (heads, q-blocks)
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_attn_kernel, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(bh(q), bh(k), bh(v))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def best_attention_fn(interpret: bool = False):
+    """``full_attention``-compatible fn: the Pallas kernel on a SINGLE TPU
+    device, exact jnp elsewhere.
+
+    Multi-device jit programs keep the jnp path: a Mosaic custom call has no
+    GSPMD partitioning rule, so under pjit it would have to be wrapped in
+    shard_map per mesh — the sequence-parallel tier (parallel/context.py)
+    covers that case instead.
+    """
+    single_tpu = jax.devices()[0].platform == "tpu" and jax.device_count() == 1
+    if single_tpu or interpret:
+        return functools.partial(flash_attention, interpret=interpret)
+    from kfac_pytorch_tpu.parallel import context
+
+    return context.full_attention
