@@ -41,6 +41,7 @@ from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import (
     TrainState,
     kfac_flags_for_step,
+    make_eval_step,
     make_sgd,
     make_train_step,
 )
@@ -96,6 +97,16 @@ def main(argv=None):
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
     mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
     dp = devices.size // sp
+    n_proc = launch.size()
+    if dp % n_proc != 0:
+        # per-process row-block slicing below assumes the data axis spans
+        # processes contiguously; a seq axis spanning hosts needs a
+        # different feed layout
+        raise SystemExit(
+            f"data-axis size {dp} must be divisible by process count "
+            f"{n_proc} (lower --seq-parallel so the sequence axis does not "
+            "span hosts)"
+        )
     global_bs = args.batch_size * dp
     if launch.is_primary():
         print(f"mesh data={dp} seq={sp} global_batch={global_bs} seq_len={args.seq_len}")
@@ -165,6 +176,7 @@ def main(argv=None):
     step_fn = make_train_step(
         model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip
     )
+    eval_fn = make_eval_step(model, eval_kwargs={"train": False})
     batch_spec = P("data", "seq")
 
     # [B_total, N] contiguous streams; segments of seq_len become samples.
@@ -172,10 +184,23 @@ def main(argv=None):
     # only its contiguous row block — make_array_from_process_local_data
     # (put_sharded_batch) assembles the global batch from those shards, so
     # no host may pass the full global batch.
-    stream = data_lib.batchify_tokens(splits["train"], global_bs)
-    n_proc = launch.size()
     rows = global_bs // n_proc
-    stream = stream[launch.rank() * rows : (launch.rank() + 1) * rows]
+
+    def local_rows(split):
+        s = data_lib.batchify_tokens(splits[split], global_bs)
+        return s[launch.rank() * rows : (launch.rank() + 1) * rows]
+
+    def sharded_bptt_batches(stream):
+        # shared train/val feed: BPTT segmentation (data_lib.bptt_batches)
+        # device-put straight to the P(data, seq) layout
+        for toks, tgts in data_lib.bptt_batches(stream, args.seq_len):
+            yield put_sharded_batch(
+                mesh,
+                (np.ascontiguousarray(toks), np.ascontiguousarray(tgts)),
+                batch_spec,
+            )
+
+    stream = local_rows("train")
     max_steps = (stream.shape[1] - 1) // args.seq_len
     steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
 
@@ -187,18 +212,9 @@ def main(argv=None):
         t0 = time.perf_counter()
         loss_m = Metric("train/loss")
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
-            for i in range(steps_per_epoch):
-                off = i * args.seq_len
-                # numpy slices go straight to the sharded layout (multi-host
-                # safe; no device-0 staging hop)
-                batch = put_sharded_batch(
-                    mesh,
-                    (
-                        np.ascontiguousarray(stream[:, off : off + args.seq_len]),
-                        np.ascontiguousarray(stream[:, off + 1 : off + 1 + args.seq_len]),
-                    ),
-                    batch_spec,
-                )
+            for i, batch in enumerate(sharded_bptt_batches(stream)):
+                if i >= steps_per_epoch:
+                    break
                 flags = kfac_flags_for_step(step, kfac, epoch)
                 state, metrics = step_fn(
                     state, batch, jnp.float32(args.base_lr),
@@ -213,6 +229,17 @@ def main(argv=None):
             print(f"epoch {epoch}: loss={loss_m.avg:.4f} ppl={ppl:.1f} {tok_s:.0f} tok/s ({dt:.1f}s)")
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/ppl", ppl, epoch)
+
+        if "valid" in splits:
+            vl = Metric("val/loss")
+            for vbatch in sharded_bptt_batches(local_rows("valid")):
+                vl.update(jax.device_get(eval_fn(state, vbatch)["loss"]))
+            vppl = float(np.exp(min(vl.avg, 20.0)))
+            if launch.is_primary():
+                print(f"  val: loss={vl.avg:.4f} ppl={vppl:.1f}")
+            writer.add_scalar("val/loss", vl.avg, epoch)
+            writer.add_scalar("val/ppl", vppl, epoch)
+
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
     writer.close()

@@ -83,31 +83,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
-)
-def flash_attention(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
-    interpret: bool = False,
-) -> jnp.ndarray:
-    """Fused attention over [B, T, H, D] (layout of the transformer blocks).
-
-    Falls back to the exact jnp path for sequences shorter than one block —
-    the kernel's win is only at block scale anyway.
-    """
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    if t % block_q or t % block_k:
-        from kfac_pytorch_tpu.parallel import context
-
-        return context.full_attention(q, k, v, causal=causal)
     scale = 1.0 / math.sqrt(d)
 
-    # [B, T, H, D] -> [B·H, T, D] so the grid is (heads, q-blocks)
+    # [B, T, H, D] -> [B·H, T, D] so the grid is (heads, q-blocks, k-blocks)
     def bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
@@ -130,6 +110,58 @@ def flash_attention(
         interpret=interpret,
     )(bh(q), bh(k), bh(v))
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# pallas_call (scratch + cross-step accumulation) has no transpose rule, so
+# training needs a custom VJP: the forward runs the fused kernel; the
+# backward differentiates the exact jnp formulation (recompute — no
+# residual logits are ever stored, so fwd memory stays O(T·D)).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    from kfac_pytorch_tpu.parallel import context
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: context.full_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused attention over [B, T, H, D] (layout of the transformer blocks).
+
+    Differentiable (custom VJP: exact-recompute backward). Falls back to the
+    exact jnp path for sequences shorter than one block — the kernel's win
+    is only at block scale anyway.
+    """
+    t = q.shape[1]
+    if t % block_q or t % block_k:
+        from kfac_pytorch_tpu.parallel import context
+
+        return context.full_attention(q, k, v, causal=causal)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
 
 
 def best_attention_fn(interpret: bool = False):

@@ -9,6 +9,9 @@ setup(
         "TPU-native distributed K-FAC gradient preconditioner (JAX/XLA)"
     ),
     packages=find_packages(include=["kfac_pytorch_tpu", "kfac_pytorch_tpu.*"]),
+    # ship the native loader source so the ctypes binding can build it
+    # on-site with g++ (runtime/loader.py)
+    package_data={"kfac_pytorch_tpu.runtime": ["native/*.cpp"]},
     python_requires=">=3.10",
     install_requires=[
         "jax",
