@@ -38,9 +38,9 @@ def initialize(
 
     No-op for single-process runs (the common single-host case) and when
     called twice. On Cloud TPU pods all arguments are discovered from the
-    metadata server; on other clusters pass them (or set
-    ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` — the
-    scripts/tpu launchers do this).
+    metadata server; on other clusters pass them or set
+    ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` in the
+    environment.
     """
     global _initialized
     if _initialized:

@@ -100,6 +100,28 @@ def test_kfac_update_matches_numpy_oracle():
     assert int(state["step"]) == 1
 
 
+def test_infinite_damping_recovers_sgd_direction():
+    """damping → ∞ ⇒ (G⊗A + λI)⁻¹ → λ⁻¹I, so the preconditioned update must
+    become parallel to the raw gradient (the SGD-equivalence check SURVEY.md
+    §4 prescribes; kl_clip rescales magnitude, so compare directions)."""
+    rng = np.random.RandomState(11)
+    params = _dense_params(rng, [6, 5, 4])
+    a_c, g_s, grads = _stats_for(params, rng)
+    kfac = KFAC()  # hparams unused: damping is passed explicitly to update()
+    state = kfac.init(params)
+    new_grads, _ = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=jnp.float32(1e8),
+        update_factors=True, update_eigen=True,
+    )
+    raw = np.concatenate([np.ravel(x) for x in jax.tree_util.tree_leaves(grads)])
+    new = np.concatenate(
+        [np.ravel(x) for x in jax.tree_util.tree_leaves(jax.device_get(new_grads))]
+    )
+    cos = float(np.dot(raw, new) / (np.linalg.norm(raw) * np.linalg.norm(new)))
+    assert cos > 0.9999, f"direction diverges from SGD at infinite damping: cos={cos}"
+
+
 def test_factor_ema_accumulates_across_updates():
     rng = np.random.RandomState(1)
     params = _dense_params(rng, [4, 3], bias=False)
