@@ -241,6 +241,10 @@ def main(argv=None):
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
+        # metrics fetched a few steps late: the loop stays async (no
+        # per-step host sync) while the lag window bounds in-flight
+        # batches/steps so queued input buffers can't accumulate in HBM
+        pending = []
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
             for i, (xb, yb) in enumerate(batches):
                 if i >= steps_per_epoch:
@@ -253,8 +257,14 @@ def main(argv=None):
                     state, batch, jnp.float32(lr), jnp.float32(damping), **flags
                 )
                 step += 1
-                loss_m.update(jax.device_get(metrics["loss"]))
-                acc_m.update(jax.device_get(metrics["accuracy"]))
+                pending.append(metrics)
+                if len(pending) > 2:
+                    m = jax.device_get(pending.pop(0))
+                    loss_m.update(m["loss"])
+                    acc_m.update(m["accuracy"])
+            for m in jax.device_get(pending):
+                loss_m.update(m["loss"])
+                acc_m.update(m["accuracy"])
         dt = time.perf_counter() - t0
         imgs_per_sec = steps_per_epoch * global_bs * accum / dt
         if launch.is_primary():

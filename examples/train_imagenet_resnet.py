@@ -230,6 +230,8 @@ def main(argv=None):
 
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
+        # lag-window metric fetch: async dispatch, bounded in-flight batches
+        pending = []
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
             for i, (xb, yb) in enumerate(batch_iter):
                 if i >= steps_per_epoch:
@@ -242,8 +244,14 @@ def main(argv=None):
                     jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
                 )
                 step += 1
-                loss_m.update(jax.device_get(metrics["loss"]))
-                acc_m.update(jax.device_get(metrics["accuracy"]))
+                pending.append(metrics)
+                if len(pending) > 2:
+                    m = jax.device_get(pending.pop(0))
+                    loss_m.update(m["loss"])
+                    acc_m.update(m["accuracy"])
+            for m in jax.device_get(pending):
+                loss_m.update(m["loss"])
+                acc_m.update(m["accuracy"])
         dt = time.perf_counter() - t0
         if launch.is_primary():
             print(

@@ -211,6 +211,8 @@ def main(argv=None):
             kfac_sched.step(epoch=epoch)
         t0 = time.perf_counter()
         loss_m = Metric("train/loss")
+        # lag-window metric fetch: async dispatch, bounded in-flight batches
+        pending = []
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
             for i, batch in enumerate(sharded_bptt_batches(stream)):
                 if i >= steps_per_epoch:
@@ -221,7 +223,11 @@ def main(argv=None):
                     jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
                 )
                 step += 1
-                loss_m.update(jax.device_get(metrics["loss"]))
+                pending.append(metrics)
+                if len(pending) > 2:
+                    loss_m.update(jax.device_get(pending.pop(0))["loss"])
+            for m in jax.device_get(pending):
+                loss_m.update(m["loss"])
         dt = time.perf_counter() - t0
         ppl = float(np.exp(min(loss_m.avg, 20.0)))
         if launch.is_primary():
