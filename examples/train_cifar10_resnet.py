@@ -36,7 +36,7 @@ from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
 from kfac_pytorch_tpu.training import (
     TrainState,
     create_lr_schedule,
-    make_eval_step,
+    make_masked_eval_step,
     make_train_step,
 )
 from kfac_pytorch_tpu.training import checkpoint as ckpt
@@ -61,6 +61,11 @@ def parse_args(argv=None):
     p.add_argument("--batches-per-allreduce", type=int, default=1,
                    help="gradient-accumulation microbatches per optimizer step "
                         "(pytorch_cifar10_resnet.py:48-52)")
+    p.add_argument("--stats-all-microbatches", action="store_true",
+                   help="capture K-FAC statistics on every accumulation "
+                        "microbatch and average them (equals full-batch "
+                        "stats) instead of the reference's last-microbatch "
+                        "behavior")
     p.add_argument("--num-workers", type=int, default=4,
                    help="native loader threads (0 = single-threaded numpy "
                         "pipeline; pytorch_cifar10_resnet.py:118)")
@@ -176,8 +181,9 @@ def main(argv=None):
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
         train_kwargs={"train": True}, accum_steps=accum,
+        stats_all_microbatches=args.stats_all_microbatches,
     )
-    eval_step = make_eval_step(
+    eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
     )
     lr_factor = create_lr_schedule(world, args.warmup_epochs, args.lr_decay)
@@ -277,19 +283,25 @@ def main(argv=None):
         writer.add_scalar("train/lr", lr, epoch)
 
         if cifar_dir:
-            vl, va = Metric("val/loss"), Metric("val/accuracy")
+            # full-split masked eval: the jitted step reduces over the GLOBAL
+            # batch, so the sums below are already pod-wide — no allreduce
             val_bs = args.val_batch_size * world // n_proc
-            for xb, yb in data_lib.epoch_batches(
-                x_val, y_val, val_bs, shuffle=False, augment=False, seed=0,
+            vl_sum = vc_sum = vn = 0.0
+            for xb, yb, mb in data_lib.eval_batches(
+                x_val, y_val, val_bs,
                 num_shards=n_proc, shard_index=launch.rank(),
             ):
-                m = eval_step(state, put_global_batch(mesh, (xb, yb)))
-                vl.update(jax.device_get(m["loss"]))
-                va.update(jax.device_get(m["accuracy"]))
+                m = jax.device_get(
+                    eval_step(state, put_global_batch(mesh, (xb, yb, mb)))
+                )
+                vl_sum += float(m["loss_sum"])
+                vc_sum += float(m["correct"])
+                vn += float(m["count"])
+            val_loss, val_acc = vl_sum / vn, vc_sum / vn
             if launch.is_primary():
-                print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
-            writer.add_scalar("val/loss", vl.avg, epoch)
-            writer.add_scalar("val/accuracy", va.avg, epoch)
+                print(f"  val: loss={val_loss:.4f} acc={val_acc:.4f}")
+            writer.add_scalar("val/loss", val_loss, epoch)
+            writer.add_scalar("val/accuracy", val_acc, epoch)
 
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)

@@ -36,7 +36,7 @@ from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
 from kfac_pytorch_tpu.training import (
     TrainState,
     create_lr_schedule,
-    make_eval_step,
+    make_masked_eval_step,
     make_train_step,
 )
 from kfac_pytorch_tpu.training import checkpoint as ckpt
@@ -179,7 +179,7 @@ def main(argv=None):
         model, tx, kfac, label_smoothing=args.label_smoothing,
         train_kwargs={"train": True}, accum_steps=accum,
     )
-    eval_step = make_eval_step(
+    eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
     )
     lr_factor = create_lr_schedule(world, args.warmup_epochs, args.lr_decay)
@@ -264,20 +264,26 @@ def main(argv=None):
 
         if val_data is not None:
             x_val, y_val = val_data
-            vl, va = Metric("val/loss"), Metric("val/accuracy")
-            val_bs = args.val_batch_size * world
-            local_val_bs = val_bs // n_proc
-            for b in range(len(x_val) // val_bs):
-                lo = b * val_bs + launch.rank() * local_val_bs
-                xb = np.asarray(x_val[lo : lo + local_val_bs], np.float32)
-                yb = np.asarray(y_val[lo : lo + local_val_bs], np.int32)
-                m = eval_step(state, put_global_batch(mesh, (xb, yb)))
-                vl.update(jax.device_get(m["loss"]))
-                va.update(jax.device_get(m["accuracy"]))
+            # full-split masked eval; jitted sums are already pod-global
+            local_val_bs = args.val_batch_size * world // n_proc
+            vl_sum = vc_sum = vn = 0.0
+            for xb, yb, mb in data_lib.eval_batches(
+                x_val, y_val, local_val_bs,
+                num_shards=n_proc, shard_index=launch.rank(),
+            ):
+                xb = np.asarray(xb, np.float32)
+                yb = np.asarray(yb, np.int32)
+                m = jax.device_get(
+                    eval_step(state, put_global_batch(mesh, (xb, yb, mb)))
+                )
+                vl_sum += float(m["loss_sum"])
+                vc_sum += float(m["correct"])
+                vn += float(m["count"])
+            val_loss, val_acc = vl_sum / vn, vc_sum / vn
             if launch.is_primary():
-                print(f"  val: loss={vl.avg:.4f} acc={va.avg:.4f}")
-            writer.add_scalar("val/loss", vl.avg, epoch)
-            writer.add_scalar("val/accuracy", va.avg, epoch)
+                print(f"  val: loss={val_loss:.4f} acc={val_acc:.4f}")
+            writer.add_scalar("val/loss", val_loss, epoch)
+            writer.add_scalar("val/accuracy", val_acc, epoch)
 
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)

@@ -41,13 +41,13 @@ def get_block_boundary(
     """
     if index >= block_count:
         raise ValueError(
-            f"Index ({index}) greater than number of requested blocks "
-            f"({block_count})"
+            f"block index {index} is out of range for a {block_count}-block "
+            "partition"
         )
     if block_count > min(shape):
         raise ValueError(
-            f"Requested blocks ({block_count}) greater than minimum possible "
-            f"blocks for shape {tuple(shape)}"
+            f"cannot carve {block_count} diagonal blocks out of shape "
+            f"{tuple(shape)}; at most min(shape) blocks fit"
         )
     block_shape = [x // block_count for x in shape]
     block_start = [x * index for x in block_shape]

@@ -105,13 +105,15 @@ class KFAC:
         _validate("diagonal block approx count", 0 < diag_blocks, diag_blocks)
         if kfac_update_freq % fac_update_freq != 0:
             print(
-                "WARNING: it is suggested that kfac_update_freq be a multiple "
-                "of fac_update_freq"
+                "WARNING: kfac_update_freq does not divide evenly by "
+                "fac_update_freq; eigendecompositions will sometimes run on "
+                "stale factors"
             )
         if diag_blocks != 1:
             print(
-                "WARNING: diag_blocks > 1 is experimental and may give poor "
-                "results."
+                "WARNING: the block-diagonal factor approximation "
+                "(diag_blocks > 1) trades accuracy for parallelism — expect "
+                "degraded convergence on some models"
             )
 
         self.factor_decay = factor_decay

@@ -93,6 +93,37 @@ def epoch_batches(
         yield xb, y[take]
 
 
+def eval_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    num_shards: int = 1,
+    shard_index: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Full-split evaluation batches: padded tail + validity mask.
+
+    Unlike :func:`epoch_batches` (drop-last, for training), this covers EVERY
+    sample: the ragged tail is padded up to ``batch_size`` by repeating
+    sample 0 with a zero mask entry, so masked metric sums over all yielded
+    batches equal metrics over the whole split. All shards yield the same
+    number of batches (pad-heavy shards pad more) so multi-host eval steps
+    stay collectively in lockstep.
+    """
+    idx = np.arange(len(x))
+    if num_shards > 1:
+        idx = idx[shard_index::num_shards]
+    longest_shard = (len(x) + num_shards - 1) // num_shards
+    n_batches = (longest_shard + batch_size - 1) // batch_size
+    for b in range(n_batches):
+        take = idx[b * batch_size : (b + 1) * batch_size]
+        k = len(take)
+        mask = np.zeros(batch_size, np.float32)
+        mask[:k] = 1.0
+        if k < batch_size:
+            take = np.concatenate([take, np.zeros(batch_size - k, idx.dtype)])
+        yield x[take], y[take], mask
+
+
 def synthetic_batches(
     batch_size: int,
     image_shape: Tuple[int, int, int],

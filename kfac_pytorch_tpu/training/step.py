@@ -57,16 +57,23 @@ def make_sgd(momentum: float = 0.9, weight_decay: float = 0.0):
     return optax.chain(*chain)
 
 
-def softmax_cross_entropy(
+def per_sample_cross_entropy(
     logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
 ) -> jnp.ndarray:
-    """Mean CE with optional label smoothing (examples/utils.py:19-31)."""
+    """Per-sample CE with optional label smoothing → shape ``[batch]``."""
     num_classes = logits.shape[-1]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
     if label_smoothing > 0.0:
         onehot = (1.0 - label_smoothing) * onehot + label_smoothing / num_classes
-    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    return -jnp.sum(onehot * logp, axis=-1)
+
+
+def softmax_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
+) -> jnp.ndarray:
+    """Mean CE with optional label smoothing (examples/utils.py:19-31)."""
+    return jnp.mean(per_sample_cross_entropy(logits, labels, label_smoothing))
 
 
 def _variables(params, batch_stats, extra=None):
@@ -93,6 +100,7 @@ def make_train_step(
     train_kwargs: Optional[dict] = None,
     accum_steps: int = 1,
     grad_clip: float = 0.0,
+    stats_all_microbatches: bool = False,
 ):
     """Build the jitted train step.
 
@@ -106,9 +114,22 @@ def make_train_step(
     ``--batches-per-allreduce`` sub-batch loop, pytorch_cifar10_resnet.py:
     225-235): the batch arrives with a leading ``[accum_steps, ...]``
     microbatch axis (sharded ``P(None, 'data')``), grads are averaged over a
-    ``lax.scan`` of microbatches, and — matching the reference, whose hooks
-    overwrite ``m_a``/``m_g`` every forward — K-FAC statistics come from the
-    LAST microbatch only.
+    ``lax.scan`` of microbatches. K-FAC statistics default to the LAST
+    microbatch only — the structural analog of the reference, whose hooks
+    overwrite ``m_a``/``m_g`` every sub-batch forward. Two deliberate
+    divergences from the reference under accumulation:
+
+    * The reference pre-divides each sub-batch loss by the accumulation
+      count before ``backward()`` (pytorch_cifar10_resnet.py:230-234), so
+      its hooked grad-outputs — and hence G — shrink by ``accum_steps²``.
+      Here statistics come from the UNSCALED microbatch loss, keeping the
+      G/damping balance identical to the ``accum_steps == 1`` run: the
+      curvature estimate should not depend on how the batch was split.
+    * ``stats_all_microbatches=True`` captures statistics on EVERY
+      microbatch and averages them, which equals computing them on the full
+      effective batch at once (each microbatch stat is an unbiased
+      per-sample average) — strictly better statistics at the cost of
+      running the capture path in the scan body.
     """
     train_kwargs = dict(train_kwargs or {})
 
@@ -209,6 +230,46 @@ def make_train_step(
         grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
         return lsum * inv, asum * inv, grads, bs, a_c, g_s
 
+    def accum_loss_and_grads_all_stats(state, images, labels):
+        # stats_all_microbatches path: capture runs in EVERY scan iteration
+        # and the per-microbatch factor statistics are averaged (== the
+        # full-effective-batch statistics; see make_train_step docstring).
+        stat_shapes = jax.eval_shape(
+            loss_and_grads_captured,
+            state.params, state.batch_stats, images[0], labels[0],
+        )
+        zeros_like_shape = lambda tree: jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), tree
+        )
+
+        def body(carry, xs):
+            bs, gsum, lsum, asum, a_sum, g_sum = carry
+            im, lb = xs
+            loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_captured(
+                state.params, bs, im, lb
+            )
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            a_sum = jax.tree_util.tree_map(jnp.add, a_sum, a_c)
+            g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g_s)
+            return (new_bs, gsum, lsum + loss, asum + acc, a_sum, g_sum), None
+
+        carry = (
+            state.batch_stats,
+            jax.tree_util.tree_map(jnp.zeros_like, state.params),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+            zeros_like_shape(stat_shapes[4]),
+            zeros_like_shape(stat_shapes[5]),
+        )
+        (bs, gsum, lsum, asum, a_sum, g_sum), _ = lax.scan(
+            body, carry, (images, labels)
+        )
+        inv = 1.0 / accum_steps
+        grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
+        a_c = jax.tree_util.tree_map(lambda a: a * inv, a_sum)
+        g_s = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+        return lsum * inv, asum * inv, grads, bs, a_c, g_s
+
     def train_step(
         state: TrainState,
         batch: Tuple[jnp.ndarray, jnp.ndarray],
@@ -221,7 +282,11 @@ def make_train_step(
     ):
         images, labels = batch
         capture_stats = kfac is not None and update_factors
-        if accum_steps > 1:
+        if accum_steps > 1 and capture_stats and stats_all_microbatches:
+            loss, acc, grads, new_bs, a_c, g_s = accum_loss_and_grads_all_stats(
+                state, images, labels
+            )
+        elif accum_steps > 1:
             loss, acc, grads, new_bs, a_c, g_s = accum_loss_and_grads(
                 state, images, labels, capture_stats
             )
@@ -288,6 +353,36 @@ def make_eval_step(model, label_smoothing: float = 0.0, eval_kwargs: Optional[di
             "accuracy": jnp.mean(
                 (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
             ),
+        }
+
+    return jax.jit(eval_step)
+
+
+def make_masked_eval_step(
+    model, label_smoothing: float = 0.0, eval_kwargs: Optional[dict] = None
+):
+    """Jitted masked eval step for full-split evaluation.
+
+    Takes ``(images, labels, mask)`` batches (see ``data.eval_batches``) and
+    returns GLOBAL sums ``{'loss_sum', 'correct', 'count'}`` — padded tail
+    samples carry ``mask == 0`` and contribute nothing, so accumulating these
+    sums over an epoch and dividing by ``count`` evaluates the entire split
+    (the reference evaluates the full val set; the drop-last train iterator
+    must not be reused for eval).
+    """
+    eval_kwargs = dict(eval_kwargs or {})
+
+    def eval_step(state: TrainState, batch):
+        images, labels, mask = batch
+        logits = model.apply(
+            _variables(state.params, state.batch_stats), images, **eval_kwargs
+        )
+        ce = per_sample_cross_entropy(logits, labels, label_smoothing)
+        correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        return {
+            "loss_sum": jnp.sum(ce * mask),
+            "correct": jnp.sum(correct * mask),
+            "count": jnp.sum(mask),
         }
 
     return jax.jit(eval_step)

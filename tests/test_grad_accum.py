@@ -118,6 +118,54 @@ def test_accum_kfac_stats_from_last_microbatch():
         np.testing.assert_allclose(fa[name]["G"], fb[name]["G"], rtol=1e-5, atol=1e-6)
 
 
+def test_accum_stats_all_microbatches_match_full_batch():
+    """With stats_all_microbatches=True the averaged per-microbatch K-FAC
+    statistics must equal a full-batch capture over the whole effective
+    batch (each microbatch stat is an unbiased per-sample average)."""
+    model = TinyNet()
+    tx = make_sgd(momentum=0.0)
+    x, y = _batch(12, seed=2)
+    kfac_a = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+    kfac_b = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+    s_acc = _state(model, x, tx, kfac_a)
+    s_full = _state(model, x, tx, kfac_b)
+
+    acc = make_train_step(
+        model, tx, kfac_a, train_kwargs={"train": True}, accum_steps=3,
+        stats_all_microbatches=True,
+    )
+    full = make_train_step(model, tx, kfac_b, train_kwargs={"train": True})
+
+    s_acc, m_acc = acc(
+        s_acc,
+        (x.reshape(3, 4, 8, 8, 3), y.reshape(3, 4)),
+        jnp.float32(0.05),
+        jnp.float32(0.01),
+        update_factors=True,
+        update_eigen=True,
+    )
+    s_full, m_full = full(
+        s_full,
+        (x, y),
+        jnp.float32(0.05),
+        jnp.float32(0.01),
+        update_factors=True,
+        update_eigen=True,
+    )
+    fa = jax.device_get(s_acc.kfac_state["factors"])
+    fb = jax.device_get(s_full.kfac_state["factors"])
+    for name in fa:
+        np.testing.assert_allclose(fa[name]["A"], fb[name]["A"], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(fa[name]["G"], fb[name]["G"], rtol=1e-5, atol=1e-6)
+    # grads (and hence the post-step params) must agree too
+    np.testing.assert_allclose(float(m_acc["loss"]), float(m_full["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s_acc.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s_full.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
 def test_accum_with_bn_and_mesh():
     """ResNet-20 (BN) + K-FAC + accumulation on the 8-device mesh runs and
     decreases loss; accum batches shard P(None, 'data')."""

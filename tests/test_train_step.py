@@ -114,6 +114,52 @@ def test_eval_step():
     assert np.isfinite(float(m["loss"]))
 
 
+def test_masked_eval_covers_full_split():
+    """eval_batches + make_masked_eval_step must evaluate EVERY sample once,
+    at any (batch size, shard count) — including ragged tails — and match a
+    direct whole-split computation."""
+    from kfac_pytorch_tpu.training.data import eval_batches
+    from kfac_pytorch_tpu.training.step import make_masked_eval_step
+
+    model, state, _, _ = _setup()
+    r = np.random.RandomState(11)
+    n = 37  # deliberately ragged vs any batch size below
+    x = r.randn(n, 16, 16, 3).astype(np.float32)
+    y = r.randint(0, 10, size=n).astype(np.int32)
+
+    ev = make_masked_eval_step(model, eval_kwargs={"train": False})
+    # ground truth: whole split in one masked batch
+    whole = jax.device_get(
+        ev(state, (jnp.asarray(x), jnp.asarray(y), jnp.ones(n, np.float32)))
+    )
+
+    for batch_size, shards in [(8, 1), (5, 3), (16, 4)]:
+        tl = tc = tn = 0.0
+        seen = 0
+        for si in range(shards):
+            for xb, yb, mb in eval_batches(x, y, batch_size, shards, si):
+                m = jax.device_get(ev(state, (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))))
+                tl += float(m["loss_sum"])
+                tc += float(m["correct"])
+                tn += float(m["count"])
+                seen += int(mb.sum())
+        assert seen == n, (batch_size, shards)
+        assert tn == n
+        np.testing.assert_allclose(tl, float(whole["loss_sum"]), rtol=1e-4)
+        np.testing.assert_allclose(tc, float(whole["correct"]), rtol=0, atol=0.5)
+
+
+def test_eval_batches_shards_same_batch_count():
+    """Every shard must yield the same number of batches (pod lockstep)."""
+    from kfac_pytorch_tpu.training.data import eval_batches
+
+    x = np.zeros((21, 2), np.float32)
+    y = np.zeros(21, np.int32)
+    counts = [len(list(eval_batches(x, y, 4, 4, si))) for si in range(4)]
+    assert len(set(counts)) == 1
+    assert counts[0] == 2  # ceil(ceil(21/4)/4)
+
+
 def test_kfac_flags_for_step_gating():
     kfac = KFAC(fac_update_freq=10, kfac_update_freq=100)
 
