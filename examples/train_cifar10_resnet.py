@@ -195,21 +195,35 @@ def main(argv=None):
     # use the native pipeline when every host can build/load it (its shuffle
     # RNG differs from numpy's, so a split choice breaks disjoint sharding).
     all_have_data = bool(launch.host_min(cifar_dir is not None))
-    # all_have_data is already host-agreed, so the short-circuit below is
-    # consistent across hosts — and skips the (slow) native-lib build on
-    # synthetic/no-data runs that would never use it.
+    # both decisions are host-agreed collectives, reached by every host in
+    # the same order regardless of local state; the will_have_arrays gate
+    # (host-consistent: args are identical everywhere) skips the slow
+    # native-lib g++ build on pure --synthetic runs that never use it
+    will_have_arrays = all_have_data or not args.synthetic
     use_native = bool(
         launch.host_min(
-            all_have_data and args.num_workers > 0 and runtime.native_available()
+            will_have_arrays and args.num_workers > 0 and runtime.native_available()
         )
     )
     if cifar_dir and not all_have_data:
-        print(f"host {launch.rank()}: data found but other hosts lack it; using --synthetic")
+        print(f"host {launch.rank()}: data found but other hosts lack it; using stand-in data")
         cifar_dir = None
     train_loader = None
+    x_train = x_val = None
     if cifar_dir:
         x_train, y_train = data_lib.load_cifar10(cifar_dir, train=True)
         x_val, y_val = data_lib.load_cifar10(cifar_dir, train=False)
+        source = f"CIFAR-10 from {cifar_dir}"
+    elif not args.synthetic:
+        # zero-egress image, no dataset on disk: use the deterministic
+        # LEARNABLE stand-in so convergence comparisons (K-FAC vs SGD per
+        # epoch) remain meaningful; --synthetic keeps the pure-noise
+        # benchmark pipeline
+        (x_train, y_train), (x_val, y_val) = data_lib.synthetic_cifar_like(
+            seed=args.seed
+        )
+        source = "synthetic-learnable stand-in (no CIFAR-10 on this image)"
+    if x_train is not None:
         steps_per_epoch = len(x_train) // (global_bs * accum)
         if use_native:
             train_loader = runtime.NativeEpochLoader(
@@ -219,10 +233,8 @@ def main(argv=None):
             )
         if launch.is_primary():
             pipe = "native" if train_loader else "numpy"
-            print(f"CIFAR-10 from {cifar_dir}: {len(x_train)} train / {len(x_val)} val ({pipe} pipeline)")
+            print(f"{source}: {len(x_train)} train / {len(x_val)} val ({pipe} pipeline)")
     else:
-        if not args.synthetic:
-            print("no CIFAR-10 data found; falling back to --synthetic")
         steps_per_epoch = args.steps_per_epoch or 50
     if args.steps_per_epoch:
         steps_per_epoch = min(steps_per_epoch, args.steps_per_epoch)
@@ -235,7 +247,7 @@ def main(argv=None):
             kfac_sched.step(epoch=epoch)
         if train_loader is not None:
             batches = train_loader.epoch(args.seed + epoch)
-        elif cifar_dir:
+        elif x_train is not None:
             batches = data_lib.epoch_batches(
                 x_train, y_train, local_bs * accum, shuffle=True, augment=True,
                 seed=args.seed + epoch,
@@ -282,7 +294,7 @@ def main(argv=None):
         writer.add_scalar("train/accuracy", acc_m.avg, epoch)
         writer.add_scalar("train/lr", lr, epoch)
 
-        if cifar_dir:
+        if x_val is not None:
             # full-split masked eval: the jitted step reduces over the GLOBAL
             # batch, so the sums below are already pod-wide — no allreduce
             val_bs = args.val_batch_size * world // n_proc

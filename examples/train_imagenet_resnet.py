@@ -9,9 +9,14 @@ resume needs no broadcast step — the restored pytree is device_put with the
 replicated sharding.
 
 Data: an ImageFolder-style tree is impractical in this zero-egress image;
-the pipeline consumes preprocessed numpy shards (``--data-dir`` with
-``train_x.npy``/``train_y.npy``/``val_x.npy``/``val_y.npy``, NHWC uint8/
-float32) or synthetic batches (``--synthetic``).
+the pipeline consumes numpy shards (``--data-dir`` with ``train_x.npy``/
+``train_y.npy``/``val_x.npy``/``val_y.npy``, NHWC uint8 raw pixels —
+recommended, stored at e.g. 256×256 — or float32 pre-normalized) or
+synthetic batches (``--synthetic``). Training applies the reference's full
+augmentation stack (RandomResizedCrop(size)+flip; val Resize(--val-resize)+
+CenterCrop, pytorch_imagenet_resnet.py:154-193) via the native C++ worker
+pool (runtime/native/loader.cpp modes 2/3) with a numpy fallback; uint8
+inputs are normalized with the ImageNet stats in the loader.
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture, runtime
 from kfac_pytorch_tpu.models import imagenet_resnet
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
@@ -55,6 +60,14 @@ def parse_args(argv=None):
     p.add_argument("--data-dir", default=None, help="numpy-shard data dir")
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--val-resize", type=int, default=256,
+                   help="eval shorter-side resize before the center crop")
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable train augmentation (pass shards through)")
+    p.add_argument("--num-workers", type=int, default=4,
+                   help="native data-pipeline threads; 0 forces the numpy "
+                        "fallback path (pytorch_imagenet_resnet.py's "
+                        "DataLoader workers analog)")
     p.add_argument("--log-dir", default="./logs")
     p.add_argument("--checkpoint-dir", default="./checkpoints")
     p.add_argument("--model", default="resnet50")
@@ -103,6 +116,13 @@ def _npy_shards(data_dir, split):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.val_resize < args.image_size:
+        raise SystemExit(
+            f"--val-resize ({args.val_resize}) must be >= --image-size "
+            f"({args.image_size}): Resize(shorter side) must cover the "
+            "CenterCrop (the transform stack replicates borders otherwise, "
+            "silently diverging from the reference's torchvision behavior)"
+        )
 
     launch.initialize()  # multi-host wiring; no-op single-process
     mesh = data_parallel_mesh()
@@ -190,8 +210,57 @@ def main(argv=None):
     val_data = None if args.synthetic else (
         _npy_shards(args.data_dir, "val") if args.data_dir else None
     )
+    # host-agreement collectives (same contract as the CIFAR trainer): every
+    # host must make the data/pipeline decisions identically or the pod
+    # desyncs — see train_cifar10_resnet.py for the full rationale.
+    all_have_data = bool(launch.host_min(train_data is not None))
+    if train_data is not None and not all_have_data:
+        print(f"host {launch.rank()}: data found but other hosts lack it; using --synthetic")
+        train_data = val_data = None
+    # the eval loop runs pod-global collectives, so val presence must be
+    # host-agreed too — a host missing only val shards must not desync
+    if not bool(launch.host_min(val_data is not None)):
+        if val_data is not None:
+            print(f"host {launch.rank()}: val shards found but other hosts lack them; skipping eval")
+        val_data = None
+    augment = not args.no_augment
+    use_native = bool(
+        launch.host_min(
+            all_have_data and args.num_workers > 0 and runtime.native_available()
+        )
+    )
+
+    train_loader = None
     if train_data is not None:
-        steps_per_epoch = len(train_data[0]) // (global_bs * accum)
+        x_train, y_train = train_data
+        uint8 = x_train.dtype == np.uint8
+        stored = tuple(x_train.shape[1:3])
+        steps_per_epoch = len(x_train) // (global_bs * accum)
+        # the reference train stack is RandomResizedCrop(size)+flip
+        # (pytorch_imagenet_resnet.py:154-166); without augmentation,
+        # same-size float shards pass through and anything else center-crops
+        if augment:
+            train_mode = "rrc"
+        elif stored == (im, im) and not uint8:
+            train_mode = "none"
+        else:
+            train_mode = "centercrop"
+        norm = dict(mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD) if uint8 else {}
+        if use_native:
+            train_loader = runtime.NativeEpochLoader(
+                x_train, y_train, local_bs * accum, shuffle=True,
+                num_shards=n_proc, shard_index=launch.rank(),
+                mode=train_mode, out_size=(im, im),
+                resize_size=args.val_resize, copy=False,
+                num_workers=args.num_workers, **norm,
+            )
+        if launch.is_primary():
+            print(
+                f"ImageNet shards: {len(x_train)} train / "
+                f"{len(val_data[0]) if val_data else 0} val, stored {stored} "
+                f"{x_train.dtype}, train={train_mode} "
+                f"({'native' if train_loader else 'numpy'} pipeline)"
+            )
     else:
         if not args.synthetic:
             print("no data found; falling back to --synthetic")
@@ -205,22 +274,31 @@ def main(argv=None):
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
-        if train_data is not None:
+        if train_loader is not None:
+            batch_iter = train_loader.epoch(args.seed + epoch)
+        elif train_data is not None:
             x_train, y_train = train_data
-            # same seeded permutation on every host; interleaved slice per
-            # host (the DistributedSampler pattern)
-            order = np.random.RandomState(args.seed + epoch).permutation(
+            # numpy fallback: same seeded permutation on every host;
+            # interleaved slice per host (the DistributedSampler pattern)
+            rng = np.random.RandomState(args.seed + epoch)
+            order = rng.permutation(
                 len(x_train) // global_bs * global_bs
             )[launch.rank() :: n_proc]
 
             def batches():
                 n = local_bs * accum
                 for b in range(steps_per_epoch):
-                    take = order[b * n : (b + 1) * n]
-                    yield (
-                        np.asarray(x_train[take], np.float32),
-                        np.asarray(y_train[take], np.int32),
-                    )
+                    take = np.sort(order[b * n : (b + 1) * n])  # mmap-friendly
+                    xb, yb = x_train[take], np.asarray(y_train[take], np.int32)
+                    if train_mode == "rrc":
+                        xb = data_lib.imagenet_train_augment(xb, im, rng)
+                    elif train_mode == "centercrop":
+                        xb = data_lib.imagenet_eval_transform(
+                            xb, im, resize_size=args.val_resize
+                        )
+                    else:
+                        xb = np.asarray(xb, np.float32)
+                    yield xb, yb
 
             batch_iter = batches()
         else:
@@ -267,11 +345,32 @@ def main(argv=None):
             # full-split masked eval; jitted sums are already pod-global
             local_val_bs = args.val_batch_size * world // n_proc
             vl_sum = vc_sum = vn = 0.0
+            val_passthrough = (
+                tuple(x_val.shape[1:3]) == (im, im) and x_val.dtype != np.uint8
+            )
+            val_norm = (
+                dict(mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD)
+                if x_val.dtype == np.uint8 else {}
+            )
             for xb, yb, mb in data_lib.eval_batches(
                 x_val, y_val, local_val_bs,
                 num_shards=n_proc, shard_index=launch.rank(),
             ):
-                xb = np.asarray(xb, np.float32)
+                # the reference eval stack (Resize + CenterCrop,
+                # pytorch_imagenet_resnet.py:180-193); native threaded
+                # transform when available, per-image numpy otherwise
+                if val_passthrough:
+                    xb = np.asarray(xb, np.float32)
+                elif use_native:
+                    xb = runtime.native_transform(
+                        xb, (im, im), mode="centercrop",
+                        resize_size=args.val_resize,
+                        num_workers=args.num_workers, **val_norm,
+                    )
+                else:
+                    xb = data_lib.imagenet_eval_transform(
+                        xb, im, resize_size=args.val_resize
+                    )
                 yb = np.asarray(yb, np.int32)
                 m = jax.device_get(
                     eval_step(state, put_global_batch(mesh, (xb, yb, mb)))

@@ -9,12 +9,21 @@ schedule the reduction with everything else (no host sync).
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax.numpy as jnp
 from jax import lax
 
 _HIGHEST = lax.Precision.HIGHEST
+# Eigenbasis rotations default to HIGH (3-pass bf16 error compensation,
+# ~f32-accurate for orthonormal Q): the rotations are the EVERY-STEP hot path
+# (4 matmuls x ~54 layers on ResNet-50, ~2.5e11 f32 FLOPs) and HIGHEST's
+# 6-pass emulation alone costs ~4 ms/step on v5e — most of the measured
+# r2 overhead (BENCH_r02.json). Factor/eigh math stays HIGHEST: those feed
+# eigendecompositions, where bf16 error is genuinely destructive, and they
+# amortize over fac/kfac_update_freq. Measured equal-convergence evidence:
+# logs/cifar10_resnet32_*.jsonl (K-FAC curves with HIGH rotations).
+_ROTATION_PRECISION = lax.Precision.HIGH
 
 
 def precondition_mat(
@@ -24,6 +33,7 @@ def precondition_mat(
     d_a: jnp.ndarray,
     d_g: jnp.ndarray,
     damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
 ) -> jnp.ndarray:
     """Apply ``(G ⊗ A + damping·I)⁻¹`` to a ``[out, in]`` gradient matrix.
 
@@ -35,12 +45,57 @@ def precondition_mat(
         v  = QG · v2 · QAᵀ
     """
     v1 = jnp.matmul(
-        jnp.matmul(q_g.T, grad_mat, precision=_HIGHEST), q_a, precision=_HIGHEST
+        jnp.matmul(q_g.T, grad_mat, precision=precision), q_a, precision=precision
     )
     v2 = v1 / (d_g[:, None] * d_a[None, :] + damping)
     return jnp.matmul(
-        jnp.matmul(q_g, v2, precision=_HIGHEST), q_a.T, precision=_HIGHEST
+        jnp.matmul(q_g, v2, precision=precision), q_a.T, precision=precision
     )
+
+
+def precondition_all(
+    grad_mats: Dict[str, jnp.ndarray],
+    eigen: Dict[str, Dict[str, jnp.ndarray]],
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> Dict[str, jnp.ndarray]:
+    """Precondition every layer's gradient matrix, batching same-shape layers.
+
+    The per-layer loop hands XLA ~54 sequential small triple-matmul chains on
+    ResNet-50 — each too small to fill the MXU. Layers whose ``[out, in]``
+    shapes coincide (bottleneck blocks repeat identical shapes 3-6x) are
+    stacked and preconditioned with ONE batched einsum chain instead; results
+    come back keyed as given. Exact-shape grouping keeps the math bit-identical
+    to :func:`precondition_mat` (no padding; matmul has no per-shape compile
+    cliff to bucket around, unlike eigh — see ops/eigh.py).
+    """
+    groups: Dict[Tuple[int, int], list] = {}
+    for name, g in grad_mats.items():
+        groups.setdefault(g.shape, []).append(name)
+
+    out: Dict[str, jnp.ndarray] = {}
+    for shape, names in groups.items():
+        if len(names) == 1:
+            name = names[0]
+            e = eigen[name]
+            out[name] = precondition_mat(
+                grad_mats[name], e["QA"], e["QG"], e["dA"], e["dG"], damping,
+                precision,
+            )
+            continue
+        gm = jnp.stack([grad_mats[n] for n in names])  # [k, out, in]
+        qa = jnp.stack([eigen[n]["QA"] for n in names])  # [k, in, in]
+        qg = jnp.stack([eigen[n]["QG"] for n in names])  # [k, out, out]
+        da = jnp.stack([eigen[n]["dA"] for n in names])  # [k, in]
+        dg = jnp.stack([eigen[n]["dG"] for n in names])  # [k, out]
+        v1 = jnp.einsum("kji,kjl->kil", qg, gm, precision=precision)
+        v1 = jnp.einsum("kil,klm->kim", v1, qa, precision=precision)
+        v2 = v1 / (dg[:, :, None] * da[:, None, :] + damping)
+        v = jnp.einsum("kij,kjl->kil", qg, v2, precision=precision)
+        v = jnp.einsum("kil,kml->kim", v, qa, precision=precision)
+        for row, name in enumerate(names):
+            out[name] = v[row]
+    return out
 
 
 def kl_clip_coefficient(

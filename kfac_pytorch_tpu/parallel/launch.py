@@ -88,10 +88,53 @@ def device_count() -> int:
     return jax.device_count()
 
 
+_local_rank_cache: Optional[int] = None
+
+
 def local_rank() -> int:
     """Index of this process among processes on the same node
-    (``hvd.local_rank()`` analog; used for e.g. per-node dataset staging)."""
-    return int(os.environ.get("LOCAL_RANK", 0))
+    (``hvd.local_rank()`` analog; used for e.g. per-node dataset staging).
+
+    Resolution order: launcher-set env vars (torchrun / OpenMPI / MVAPICH2 /
+    SLURM conventions), then — since nothing sets those on a plain TPU VM
+    pod — a one-time allgather of hostnames, ranking this process among the
+    processes that share its host by global process index. The collective
+    result is cached (topology is static for the life of the world).
+
+    WARNING: on a multi-process world without those env vars, the FIRST call
+    is a blocking collective — every process must reach it. Do not call this
+    only on some ranks (e.g. inside an ``is_primary()`` branch) or from
+    mixed-environment launches where only some hosts set LOCAL_RANK; either
+    pattern deadlocks the allgather.
+    """
+    global _local_rank_cache
+    for var in (
+        "LOCAL_RANK",
+        "OMPI_COMM_WORLD_LOCAL_RANK",
+        "MV2_COMM_WORLD_LOCAL_RANK",
+        "SLURM_LOCALID",
+    ):
+        if var in os.environ:
+            return int(os.environ[var])
+    if jax.process_count() == 1:
+        return 0
+    if _local_rank_cache is None:
+        import hashlib
+        import socket
+
+        from jax.experimental import multihost_utils
+
+        host = int.from_bytes(
+            hashlib.sha256(socket.gethostname().encode()).digest()[:8], "big"
+        ) % (2**31)
+        mine = jax.process_index()
+        pairs = multihost_utils.process_allgather(
+            np.asarray([host, mine], dtype=np.int64)
+        ).reshape(-1, 2)
+        _local_rank_cache = int(
+            sum(1 for h, pid in pairs if h == host and pid < mine)
+        )
+    return _local_rank_cache
 
 
 def is_primary() -> bool:

@@ -146,16 +146,21 @@ def sharded_eigen_update(
     granularity: int = 512,
     minimum: int = 128,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
-    """Recompute all layers' eigendecompositions, sharded over ``axis_name``.
+    """Recompute all layers' eigendecompositions, sharded over the WHOLE mesh.
 
     ``factors`` is the replicated ``{layer: {'A', 'G'}}`` dict; returns the
     replicated ``{layer: {'QA', 'dA', 'QG', 'dG'}}`` dict with work placed
-    per ``assignment`` (see module docstring for the SPMD plan).
+    per ``assignment`` (see module docstring for the SPMD plan). Owners are
+    FLAT device indices over every mesh axis (row-major in ``mesh.axis_names``
+    order) — a data×seq mesh splits eigh work across all devices instead of
+    replicating it per non-data axis (the reference's Horovod world has no
+    axes to begin with; every rank is an eigh worker,
+    kfac_preconditioner.py:383-396). ``axis_name`` is unused and kept for
+    call-site compatibility.
     """
-    # Shard over `axis_name` only; on a multi-axis mesh the work is
-    # replicated across the other axes (their shards all hold the same
-    # factors and compute the same slots).
-    world = mesh.shape[axis_name]
+    del axis_name
+    axes = tuple(mesh.axis_names)
+    world = mesh.devices.size
     slots = build_slots(factors, assignment)
     groups = _bucket_groups(slots, granularity, minimum)
 
@@ -179,7 +184,10 @@ def sharded_eigen_update(
         check_vma=False,
     )
     def _inner(facs):
-        dev = lax.axis_index(axis_name)
+        # flat device index over ALL mesh axes, row-major in axis_names order
+        dev = lax.axis_index(axes[0])
+        for a in axes[1:]:
+            dev = dev * mesh.shape[a] + lax.axis_index(a)
         per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         for m, idxs in groups.items():
             all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
@@ -194,8 +202,8 @@ def sharded_eigen_update(
             # Sum-of-zeros exchange: scatter-add my rows, psum the rest in.
             kq = jnp.zeros((k, m, m), jnp.float32).at[mine].add(q)
             kd = jnp.zeros((k, m), jnp.float32).at[mine].add(d)
-            kq = lax.psum(kq, axis_name)
-            kd = lax.psum(kd, axis_name)
+            kq = lax.psum(kq, axes)
+            kd = lax.psum(kd, axes)
             for row, i in enumerate(idxs):
                 per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
         return _assemble(facs, slots, per_slot)

@@ -54,12 +54,15 @@ KFACState = Dict[str, Any]
 class KFACHParams:
     """Host-side mutable hyperparameters (the ``param_groups`` analog).
 
-    ``KFACParamScheduler`` mutates these between epochs; ``lr``/``damping``
-    enter the compiled step as traced scalars, the update freqs drive
-    host-side step-variant dispatch (kfac_preconditioner.py:351-356).
+    ``KFACParamScheduler`` mutates these between epochs; ``damping`` enters
+    the compiled step as a traced scalar, the update freqs drive host-side
+    step-variant dispatch (kfac_preconditioner.py:351-356). ``lr`` is NOT
+    stored here — the trainer's LR schedule is the single source of truth and
+    every ``update()`` call must pass it (the reference equivalently re-reads
+    lr from ``param_groups[0]`` that its ``LambdaLR`` maintains,
+    kfac_preconditioner.py:351-356).
     """
 
-    lr: float = 0.1
     damping: float = 0.001
     kl_clip: float = 0.001
     fac_update_freq: int = 10
@@ -76,7 +79,11 @@ class KFAC:
 
     Args mirror the reference ``KFAC.__init__`` (kfac_preconditioner.py:59-91)
     with identical defaults and validation; ``mesh``/``axis_name`` replace the
-    implicit Horovod world.
+    implicit Horovod world. ``lr`` is accepted and validated for reference
+    API parity only — the lr the KL clip consumes is ALWAYS the per-step
+    ``update(lr=...)`` argument (stored here as ``initial_lr``), exactly as
+    the reference re-reads scheduler-maintained ``param_groups[0]['lr']``
+    every step (kfac_preconditioner.py:351-356).
     """
 
     def __init__(
@@ -95,6 +102,7 @@ class KFAC:
         axis_name: str = "data",
         eps: float = 1e-10,
         layers: Optional[list] = None,
+        precond_precision: Optional[Any] = None,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -116,6 +124,7 @@ class KFAC:
                 "degraded convergence on some models"
             )
 
+        self.initial_lr = lr  # parity/validation only; see class docstring
         self.factor_decay = factor_decay
         self.batch_averaged = batch_averaged
         self.diag_blocks = diag_blocks
@@ -128,8 +137,10 @@ class KFAC:
         # params heuristic; REQUIRED for models mixing in non-K-FAC
         # kernel-bearing modules (grouped convs, plain nn.Dense).
         self.layers = list(layers) if layers is not None else None
+        # Precision of the every-step eigenbasis rotations (see
+        # ops/precondition.py::_ROTATION_PRECISION for the default and why).
+        self.precond_precision = precond_precision
         self.hparams = KFACHParams(
-            lr=lr,
             damping=damping,
             kl_clip=kl_clip,
             fac_update_freq=fac_update_freq,
@@ -151,13 +162,14 @@ class KFAC:
         return names, is_conv
 
     def _world(self) -> int:
-        # Size of the eigendecomposition-sharding axis ONLY: on a multi-axis
-        # mesh (e.g. data×seq) work shards over `axis_name` and is replicated
-        # across the other axes — owners must span exactly the values
-        # lax.axis_index(axis_name) takes inside sharded_eigen_update.
+        # Eigendecomposition work shards over EVERY device of the mesh —
+        # owners in the assignment table are flat device indices (row-major
+        # over mesh.axis_names), matching the flat axis_index computed inside
+        # sharded_eigen_update. A data×seq mesh therefore splits eigh work
+        # across all devices rather than replicating per seq row.
         if self.mesh is None:
             return 1
-        return self.mesh.shape[self.axis_name]
+        return int(self.mesh.devices.size)
 
     # ------------------------------------------------------------------
     # State
@@ -225,12 +237,18 @@ class KFAC:
         (see ``training.step.kfac_flags_for_step``); each combination is its
         own compiled program, so non-update steps pay zero capture/eigh cost.
         ``a_contribs``/``g_factor_stats`` come from capture.py and are
-        required iff ``update_factors``. ``lr``/``damping`` default to the
-        host-side ``hparams`` values (note: passing them as traced scalars
-        avoids recompilation when schedules change).
+        required iff ``update_factors``. ``lr`` is REQUIRED (it scales the KL
+        trust-region clip, kfac_preconditioner.py:320-326, and must track the
+        trainer's schedule — a silently-stale fallback here once meant the
+        clip used the construction-time lr). ``damping`` defaults to the
+        scheduler-maintained ``hparams.damping``; pass both as traced scalars
+        so schedules never recompile.
         """
         if lr is None:
-            lr = self.hparams.lr
+            raise ValueError(
+                "KFAC.update() requires lr= (the KL clip scales with the "
+                "trainer's current learning rate)"
+            )
         if damping is None:
             damping = self.hparams.damping
         # The layer set was fixed at init() — state IS the source of truth,
@@ -293,20 +311,18 @@ class KFAC:
                 eigen = replicated_eigen_update(facs, blocks, self.eps)
 
         # Precondition every layer's gradient, every step
-        # (kfac_preconditioner.py:401-404).
+        # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
         lgrads = capture.layer_grads(grads, names)
-        gmats = capture.grad_mats(lgrads)
-        updates = {
-            name: precond_ops.precondition_mat(
-                gmats[name].astype(jnp.float32),
-                eigen[name]["QA"],
-                eigen[name]["QG"],
-                eigen[name]["dA"],
-                eigen[name]["dG"],
-                damping,
-            )
-            for name in names
+        gmats = {
+            name: mat.astype(jnp.float32)
+            for name, mat in capture.grad_mats(lgrads).items()
         }
+        if self.precond_precision is not None:
+            updates = precond_ops.precondition_all(
+                gmats, eigen, damping, self.precond_precision
+            )
+        else:
+            updates = precond_ops.precondition_all(gmats, eigen, damping)
 
         # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
         nu = precond_ops.kl_clip_coefficient(

@@ -10,6 +10,12 @@ from kfac_pytorch_tpu.runtime.loader import (
     NativeEpochLoader,
     native_available,
     native_epoch_batches,
+    native_transform,
 )
 
-__all__ = ["NativeEpochLoader", "native_available", "native_epoch_batches"]
+__all__ = [
+    "NativeEpochLoader",
+    "native_available",
+    "native_epoch_batches",
+    "native_transform",
+]

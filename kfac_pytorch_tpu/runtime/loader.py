@@ -4,6 +4,13 @@ Builds the shared library with ``g++`` on first use (no pybind11 on this
 image — plain C ABI + ctypes keeps the binding dependency-free) and degrades
 gracefully: ``native_available()`` is False when no toolchain is present and
 callers fall back to the numpy pipeline in ``training/data.py``.
+
+Augmentation modes (see loader.cpp header; mirrors the reference's
+torchvision transform stacks):
+  'none'        — pass-through (plus dtype/normalize)
+  'padcrop'     — CIFAR pad-4 random crop + flip
+  'rrc'         — ImageNet RandomResizedCrop(out_size) + flip
+  'centercrop'  — ImageNet eval Resize(resize_size) + CenterCrop(out_size)
 """
 
 from __future__ import annotations
@@ -12,7 +19,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -23,6 +30,8 @@ _LIB = os.path.join(_NATIVE_DIR, "libkfacloader.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+
+MODES = {"none": 0, "padcrop": 1, "rrc": 2, "centercrop": 3}
 
 
 def _build() -> bool:
@@ -74,8 +83,19 @@ def _load_locked() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # x, y, n
         ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
         ctypes.c_int, ctypes.c_int, ctypes.c_int,  # batch, shards, shard_idx
-        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # shuffle, augment, pad
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # shuffle, mode, pad
         ctypes.c_int, ctypes.c_int,  # threads, depth
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # dtype, oh, ow, resize
+    ]
+    lib.kl_set_norm.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.kl_transform.restype = ctypes.c_int
+    lib.kl_transform.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,  # x, n
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c, dtype
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,  # out, oh, ow
+        ctypes.c_int, ctypes.c_int,  # mode, resize
+        ctypes.c_void_p, ctypes.c_void_p,  # mean, std
+        ctypes.c_uint64, ctypes.c_int,  # seed, threads
     ]
     lib.kl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.kl_num_batches.restype = ctypes.c_int64
@@ -95,9 +115,11 @@ class NativeEpochLoader:
     """Reusable epoch iterator backed by the C++ worker pool.
 
     Mirrors ``training.data.epoch_batches`` semantics (seeded global shuffle,
-    interleaved host shards, drop-last, pad-4-crop/flip augmentation) but
-    fills batches on ``num_workers`` native threads with ``depth`` buffers of
-    lookahead, overlapping host data prep with device steps.
+    interleaved host shards, drop-last) but fills batches on ``num_workers``
+    native threads with ``depth`` buffers of lookahead, overlapping host data
+    prep with device steps. ``mode`` selects the augmentation stack (module
+    docstring); uint8 inputs are converted to [0,1] float32 and, with
+    ``mean``/``std`` set, normalized per channel in the worker threads.
     """
 
     def __init__(
@@ -106,30 +128,57 @@ class NativeEpochLoader:
         y: np.ndarray,
         batch_size: int,
         shuffle: bool,
-        augment: bool,
+        augment: bool = False,
         num_shards: int = 1,
         shard_index: int = 0,
         pad: int = 4,
         num_workers: int = 4,
         depth: int = 4,
+        mode: Optional[str] = None,
+        out_size: Optional[Tuple[int, int]] = None,
+        resize_size: int = 256,
+        mean: Optional[Sequence[float]] = None,
+        std: Optional[Sequence[float]] = None,
+        copy: bool = True,
     ):
         lib = _load()
         if lib is None:
             raise RuntimeError("native loader unavailable (no C++ toolchain?)")
         self._lib = lib
-        # own contiguous copies in the exact dtypes the C side reads
-        self._x = np.ascontiguousarray(x, np.float32)
+        if mode is None:
+            mode = "padcrop" if augment else "none"
+        if mode not in MODES:
+            raise ValueError(f"unknown mode {mode!r}; one of {sorted(MODES)}")
+        # keep references in the exact dtypes the C side reads; `copy=False`
+        # accepts an already-contiguous array (e.g. a np.memmap of uint8
+        # ImageNet shards — copying 250 GB is not an option)
+        if x.dtype == np.uint8:
+            in_dtype = 1
+            self._x = x if (not copy and x.flags["C_CONTIGUOUS"]) else np.ascontiguousarray(x)
+        else:
+            in_dtype = 0
+            self._x = (
+                x
+                if (not copy and x.dtype == np.float32 and x.flags["C_CONTIGUOUS"])
+                else np.ascontiguousarray(x, np.float32)
+            )
         self._y = np.ascontiguousarray(y, np.int32)
         n, h, w, c = self._x.shape
+        oh, ow = out_size if out_size else (h, w)
         self.batch_size = batch_size
-        self._sample_shape = (h, w, c)
+        self._sample_shape = (oh, ow, c)
         self._ptr = lib.kl_create(
             self._x.ctypes.data, self._y.ctypes.data, n, h, w, c,
             batch_size, num_shards, shard_index,
-            int(shuffle), int(augment), pad, num_workers, depth,
+            int(shuffle), MODES[mode], pad, num_workers, depth,
+            in_dtype, oh, ow, resize_size,
         )
         if not self._ptr:
             raise RuntimeError("kl_create failed")
+        if mean is not None:
+            m = np.ascontiguousarray(mean, np.float32)
+            s = np.ascontiguousarray(std if std is not None else [1, 1, 1], np.float32)
+            lib.kl_set_norm(self._ptr, m.ctypes.data, s.ctypes.data)
 
     def epoch(self, seed: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Start a (re)shuffled epoch and yield its batches."""
@@ -160,6 +209,51 @@ class NativeEpochLoader:
             self.close()
         except Exception:
             pass
+
+
+def native_transform(
+    x: np.ndarray,
+    out_size: Tuple[int, int],
+    mode: str = "centercrop",
+    resize_size: int = 256,
+    mean: Optional[Sequence[float]] = None,
+    std: Optional[Sequence[float]] = None,
+    seed: int = 0,
+    num_workers: int = 4,
+) -> np.ndarray:
+    """One-shot threaded batch transform (modes 'rrc' / 'centercrop').
+
+    For callers that bring their own batching — e.g. the masked eval loop
+    (``training.data.eval_batches``) — but want the ImageNet transform off
+    the Python thread. Raises RuntimeError when the native lib is
+    unavailable; use ``training.data.imagenet_eval_transform`` as fallback.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable (no C++ toolchain?)")
+    if mode not in ("rrc", "centercrop"):
+        raise ValueError(f"unsupported one-shot mode {mode!r}")
+    if x.dtype == np.uint8:
+        in_dtype = 1
+        xc = x if x.flags["C_CONTIGUOUS"] else np.ascontiguousarray(x)
+    else:
+        in_dtype = 0
+        xc = np.ascontiguousarray(x, np.float32)
+    n, h, w, c = xc.shape
+    oh, ow = out_size
+    out = np.empty((n, oh, ow, c), np.float32)
+    m = np.ascontiguousarray(mean, np.float32) if mean is not None else None
+    s = np.ascontiguousarray(std if std is not None else [1, 1, 1], np.float32)
+    ok = lib.kl_transform(
+        xc.ctypes.data, n, h, w, c, in_dtype,
+        out.ctypes.data, oh, ow, MODES[mode], resize_size,
+        m.ctypes.data if m is not None else None,
+        s.ctypes.data if m is not None else None,
+        ctypes.c_uint64(seed & (2**64 - 1)), num_workers,
+    )
+    if not ok:
+        raise RuntimeError("kl_transform failed")
+    return out
 
 
 def native_epoch_batches(

@@ -1,10 +1,26 @@
 // Native threaded batch pipeline — the TPU build's equivalent of the
 // reference's torch DataLoader C++ worker pool (num_workers=4,
 // pytorch_cifar10_resnet.py:118,137-148): seeded global shuffle,
-// DistributedSampler-style interleaved sharding, pad-k random crop +
-// horizontal flip augmentation, and a bounded ring of pre-filled batch
-// buffers produced by a worker pool so host-side data prep overlaps device
-// steps.
+// DistributedSampler-style interleaved sharding, augmentation, and a bounded
+// ring of pre-filled batch buffers produced by a worker pool so host-side
+// data prep overlaps device steps.
+//
+// Augmentation modes (the reference's torchvision transform stacks):
+//   0  none                 — memcpy (plus dtype/normalize when configured)
+//   1  pad-crop + flip      — CIFAR transform_train (pad-4 random crop,
+//                             horizontal flip; pytorch_cifar10_resnet.py)
+//   2  RandomResizedCrop + flip — ImageNet transform_train
+//                             (pytorch_imagenet_resnet.py:154-166): random
+//                             area in [0.08, 1]·src, log-uniform aspect in
+//                             [3/4, 4/3], 10 attempts then center fallback,
+//                             bilinear resize to out_h×out_w, flip p=0.5
+//   3  Resize + CenterCrop  — ImageNet eval transform
+//                             (pytorch_imagenet_resnet.py:180-193): bilinear
+//                             resize shorter side to resize_size, center crop
+//
+// Inputs may be float32 or uint8 (ImageNet shards are uint8 — f32 would be
+// 770 GB); outputs are always float32, optionally normalized per channel
+// ((x/255 - mean)/std for uint8 inputs, (x - mean)/std for float inputs).
 //
 // Determinism: the epoch permutation is a Fisher–Yates driven by
 // splitmix64(seed), and per-sample augmentation parameters derive from
@@ -14,12 +30,15 @@
 //
 // C ABI:
 //   kl_create(...)            -> opaque loader
+//   kl_set_norm(p, mean, std) -> enable per-channel normalization
 //   kl_start_epoch(p, seed)   -> shuffle + spawn workers
 //   kl_num_batches(p)         -> batches per epoch (per shard)
 //   kl_next(p, out_x, out_y)  -> 1 and fills out buffers, or 0 at epoch end
 //   kl_destroy(p)
 
+#include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -36,16 +55,26 @@ inline uint64_t splitmix64(uint64_t& s) {
   return z ^ (z >> 31);
 }
 
+inline double uniform01(uint64_t& s) {
+  return double(splitmix64(s) >> 11) * (1.0 / 9007199254740992.0);
+}
+
 struct Loader {
   // dataset (borrowed pointers — the Python side keeps the arrays alive)
-  const float* x = nullptr;
+  const void* x = nullptr;  // float32 or uint8 per in_dtype
   const int32_t* y = nullptr;
   int64_t n = 0;
-  int h = 0, w = 0, c = 0;
+  int h = 0, w = 0, c = 0;          // stored sample geometry
+  int out_h = 0, out_w = 0;         // emitted geometry (mode 2/3 may differ)
   int batch = 0;
   int num_shards = 1, shard_index = 0;
-  bool shuffle = false, augment = false;
-  int pad = 4;
+  bool shuffle = false;
+  int mode = 0;                     // augmentation mode, see header
+  int pad = 4;                      // mode-1 crop padding
+  int resize_size = 256;            // mode-3 shorter-side resize
+  int in_dtype = 0;                 // 0 = float32, 1 = uint8
+  bool normalize = false;
+  float mean[3] = {0, 0, 0}, stdev[3] = {1, 1, 1};
   int threads = 4, depth = 4;
 
   // epoch state
@@ -67,42 +96,182 @@ struct Loader {
   std::vector<std::thread> pool;
   bool stopping = false;
 
-  int64_t sample_bytes() const { return int64_t(h) * w * c; }
+  int64_t in_sample_elems() const { return int64_t(h) * w * c; }
+  int64_t out_sample_elems() const { return int64_t(out_h) * out_w * c; }
 
-  void fill_batch(int64_t b, float* out_x, int32_t* out_y) {
-    const int64_t spp = sample_bytes();
+  // ---- pixel access on the stored (source) image, channel-interleaved ----
+  inline float load_px(const void* img, int r, int col, int ch) const {
+    const int64_t off = (int64_t(r) * w + col) * c + ch;
+    if (in_dtype == 1) return float(static_cast<const uint8_t*>(img)[off]) * (1.0f / 255.0f);
+    return static_cast<const float*>(img)[off];
+  }
+
+  inline float norm_px(float v, int ch) const {
+    return normalize ? (v - mean[ch]) / stdev[ch] : v;
+  }
+
+  const void* sample_ptr(int64_t src) const {
+    const int64_t elems = in_sample_elems();
+    if (in_dtype == 1) return static_cast<const uint8_t*>(x) + src * elems;
+    return static_cast<const float*>(x) + src * elems;
+  }
+
+  // Bilinear-sample into the out_h×out_w destination with the
+  // align_corners=false (torch/PIL) convention: output pixel (r, col) reads
+  // source coordinate ((r+0.5)·sy − 0.5 + oy, (col+0.5)·sx − 0.5 + ox),
+  // clamped to [lo, hi] per axis. Covers both transform stacks exactly:
+  //   RandomResizedCrop(i, j, h_c, w_c → out):  s = crop/out, o = crop start,
+  //     clamp to the crop window (torch resizes the crop, replicating its
+  //     edges)
+  //   Resize(scale) + CenterCrop(top, left):    s = 1/scale, o = top/scale,
+  //     clamp to the full image — mathematically identical to
+  //     resize-then-crop since the crop itself never interpolates
+  // Optional horizontal flip of the OUTPUT.
+  void resize_crop(const void* img, float* dst, double oy, double ox,
+                   double sy, double sx, double lo_y, double hi_y,
+                   double lo_x, double hi_x, bool flip) const {
+    for (int r = 0; r < out_h; r++) {
+      double fy = (double(r) + 0.5) * sy - 0.5 + oy;
+      fy = std::min(std::max(fy, lo_y), hi_y);
+      const int y0 = int(fy);
+      const int y1 = std::min(y0 + 1, h - 1);
+      const float wy = float(fy - double(y0));
+      float* drow = dst + int64_t(r) * out_w * c;
+      for (int col = 0; col < out_w; col++) {
+        const int oc = flip ? (out_w - 1 - col) : col;
+        double fx = (double(col) + 0.5) * sx - 0.5 + ox;
+        fx = std::min(std::max(fx, lo_x), hi_x);
+        const int x0 = int(fx);
+        const int x1 = std::min(x0 + 1, w - 1);
+        const float wx = float(fx - double(x0));
+        for (int ch = 0; ch < c; ch++) {
+          const float p00 = load_px(img, y0, x0, ch);
+          const float p01 = load_px(img, y0, x1, ch);
+          const float p10 = load_px(img, y1, x0, ch);
+          const float p11 = load_px(img, y1, x1, ch);
+          const float v = p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                          p10 * wy * (1 - wx) + p11 * wy * wx;
+          drow[int64_t(oc) * c + ch] = norm_px(v, ch);
+        }
+      }
+    }
+  }
+
+  // torchvision RandomResizedCrop.get_params (pytorch_imagenet_resnet.py's
+  // train transform): 10 attempts of (area, log-aspect) sampling, then the
+  // ratio-clamped center-crop fallback.
+  void rrc_params(uint64_t& s, int& ci, int& cj, int& ch_c, int& cw_c) const {
+    const double area = double(h) * double(w);
+    const double lo = std::log(3.0 / 4.0), hi = std::log(4.0 / 3.0);
+    for (int attempt = 0; attempt < 10; attempt++) {
+      const double target = (0.08 + uniform01(s) * 0.92) * area;
+      const double ar = std::exp(lo + uniform01(s) * (hi - lo));
+      const int cw = int(std::lround(std::sqrt(target * ar)));
+      const int chh = int(std::lround(std::sqrt(target / ar)));
+      if (cw > 0 && chh > 0 && cw <= w && chh <= h) {
+        ci = (h == chh) ? 0 : int(splitmix64(s) % uint64_t(h - chh + 1));
+        cj = (w == cw) ? 0 : int(splitmix64(s) % uint64_t(w - cw + 1));
+        ch_c = chh;
+        cw_c = cw;
+        return;
+      }
+    }
+    // fallback: clamp aspect, center crop
+    const double in_ratio = double(w) / double(h);
+    int cw, chh;
+    if (in_ratio < 3.0 / 4.0) {
+      cw = w;
+      chh = int(std::lround(double(cw) / (3.0 / 4.0)));
+    } else if (in_ratio > 4.0 / 3.0) {
+      chh = h;
+      cw = int(std::lround(double(chh) * (4.0 / 3.0)));
+    } else {
+      cw = w;
+      chh = h;
+    }
+    ci = (h - chh) / 2;
+    cj = (w - cw) / 2;
+    ch_c = chh;
+    cw_c = cw;
+  }
+
+  void fill_sample_none(const void* img, float* dst) const {
+    if (in_dtype == 0 && !normalize) {
+      std::memcpy(dst, img, size_t(in_sample_elems()) * sizeof(float));
+      return;
+    }
+    const int64_t px = int64_t(h) * w;
+    for (int64_t p = 0; p < px; p++)
+      for (int ch = 0; ch < c; ch++)
+        dst[p * c + ch] = norm_px(load_px(img, int(p / w), int(p % w), ch), ch);
+  }
+
+  void fill_sample_padcrop(const void* img, float* dst, uint64_t& s) const {
     const int side = 2 * pad + 1;
-    for (int i = 0; i < batch; i++) {
-      const int64_t pos = b * batch + i;           // position in epoch order
-      const int64_t src = order[pos];
-      out_y[i] = y[src];
-      const float* sx = x + src * spp;
-      float* dx = out_x + int64_t(i) * spp;
-      if (!augment) {
-        std::memcpy(dx, sx, spp * sizeof(float));
+    const uint64_t r = splitmix64(s);
+    const int dy = int(r % side) - pad;  // crop offset in [-pad, pad]
+    const int dxo = int((r >> 16) % side) - pad;
+    const bool flip = ((r >> 32) & 1) != 0;
+    for (int row = 0; row < h; row++) {
+      const int sr = row + dy;
+      float* drow = dst + int64_t(row) * w * c;
+      if (sr < 0 || sr >= h) {
+        for (int i = 0; i < w * c; i++) drow[i] = norm_px(0.0f, i % c);
         continue;
       }
-      uint64_t s = seed ^ (0xd1b54a32d192ed03ULL + uint64_t(pos) * 0x9e3779b97f4a7c15ULL);
-      uint64_t r = splitmix64(s);
-      const int dy = int(r % side) - pad;          // crop offset in [-pad, pad]
-      const int dxo = int((r >> 16) % side) - pad;
-      const bool flip = ((r >> 32) & 1) != 0;
-      for (int row = 0; row < h; row++) {
-        const int sr = row + dy;
-        float* drow = dx + int64_t(row) * w * c;
-        if (sr < 0 || sr >= h) {
-          std::memset(drow, 0, size_t(w) * c * sizeof(float));
-          continue;
-        }
-        for (int col = 0; col < w; col++) {
-          const int sc = (flip ? (w - 1 - col) : col) + dxo;
-          float* dpix = drow + int64_t(col) * c;
-          if (sc < 0 || sc >= w) {
-            std::memset(dpix, 0, size_t(c) * sizeof(float));
-          } else {
-            std::memcpy(dpix, sx + (int64_t(sr) * w + sc) * c, size_t(c) * sizeof(float));
-          }
-        }
+      for (int col = 0; col < w; col++) {
+        const int sc = (flip ? (w - 1 - col) : col) + dxo;
+        float* dpix = drow + int64_t(col) * c;
+        for (int ch = 0; ch < c; ch++)
+          dpix[ch] = (sc < 0 || sc >= w) ? norm_px(0.0f, ch)
+                                         : norm_px(load_px(img, sr, sc, ch), ch);
+      }
+    }
+  }
+
+  void fill_sample_rrc(const void* img, float* dst, uint64_t& s) const {
+    int ci, cj, ch_c, cw_c;
+    rrc_params(s, ci, cj, ch_c, cw_c);
+    const bool flip = uniform01(s) < 0.5;
+    resize_crop(img, dst,
+                /*oy=*/double(ci), /*ox=*/double(cj),
+                /*sy=*/double(ch_c) / out_h, /*sx=*/double(cw_c) / out_w,
+                /*lo_y=*/double(ci), /*hi_y=*/double(ci + ch_c - 1),
+                /*lo_x=*/double(cj), /*hi_x=*/double(cj + cw_c - 1), flip);
+  }
+
+  void fill_sample_centercrop(const void* img, float* dst) const {
+    // Resize(resize_size) scales the SHORTER side to resize_size (separate
+    // per-axis scales because the resized dims are rounded); CenterCrop
+    // (out_h, out_w) then selects rows/cols of that resized image. Since
+    // the crop never interpolates, a single bilinear pass at the resized
+    // scale with the crop start folded into the offset is exact.
+    const double scale = double(resize_size) / double(std::min(h, w));
+    const int rh = int(std::lround(h * scale)), rw = int(std::lround(w * scale));
+    const double sy = double(h) / rh, sx = double(w) / rw;
+    const int ty = (rh - out_h) / 2, tx = (rw - out_w) / 2;
+    resize_crop(img, dst,
+                /*oy=*/(double(ty)) * sy, /*ox=*/(double(tx)) * sx,
+                sy, sx,
+                /*lo_y=*/0.0, /*hi_y=*/double(h - 1),
+                /*lo_x=*/0.0, /*hi_x=*/double(w - 1), /*flip=*/false);
+  }
+
+  void fill_batch(int64_t b, float* out_x, int32_t* out_y) {
+    const int64_t out_elems = out_sample_elems();
+    for (int i = 0; i < batch; i++) {
+      const int64_t pos = b * batch + i;  // position in epoch order
+      const int64_t src = order[pos];
+      out_y[i] = y[src];
+      const void* sx = sample_ptr(src);
+      float* dx = out_x + int64_t(i) * out_elems;
+      uint64_t s =
+          seed ^ (0xd1b54a32d192ed03ULL + uint64_t(pos) * 0x9e3779b97f4a7c15ULL);
+      switch (mode) {
+        case 1: fill_sample_padcrop(sx, dx, s); break;
+        case 2: fill_sample_rrc(sx, dx, s); break;
+        case 3: fill_sample_centercrop(sx, dx); break;
+        default: fill_sample_none(sx, dx); break;
       }
     }
   }
@@ -171,7 +340,8 @@ struct Loader {
       std::unique_lock<std::mutex> lk(mu);
       cv_ready.wait(lk, [&] { return slot.ready_for == b; });
     }
-    std::memcpy(out_x, slot.xs.data(), size_t(batch) * sample_bytes() * sizeof(float));
+    std::memcpy(out_x, slot.xs.data(),
+                size_t(batch) * out_sample_elems() * sizeof(float));
     std::memcpy(out_y, slot.ys.data(), size_t(batch) * sizeof(int32_t));
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -187,21 +357,42 @@ struct Loader {
 
 extern "C" {
 
-void* kl_create(const float* x, const int32_t* y, int64_t n, int h, int w, int c,
+void* kl_create(const void* x, const int32_t* y, int64_t n, int h, int w, int c,
                 int batch, int num_shards, int shard_index, int shuffle,
-                int augment, int pad, int threads, int depth) {
+                int mode, int pad, int threads, int depth, int in_dtype,
+                int out_h, int out_w, int resize_size) {
   if (!x || !y || n <= 0 || batch <= 0 || num_shards <= 0 || depth <= 0) return nullptr;
+  if (in_dtype != 0 && in_dtype != 1) return nullptr;
   auto* L = new Loader();
   L->x = x; L->y = y; L->n = n; L->h = h; L->w = w; L->c = c;
   L->batch = batch; L->num_shards = num_shards; L->shard_index = shard_index;
-  L->shuffle = shuffle != 0; L->augment = augment != 0; L->pad = pad;
-  L->threads = threads; L->depth = depth;
+  L->shuffle = shuffle != 0; L->mode = mode; L->pad = pad;
+  L->threads = threads; L->depth = depth; L->in_dtype = in_dtype;
+  L->out_h = out_h > 0 ? out_h : h;
+  L->out_w = out_w > 0 ? out_w : w;
+  L->resize_size = resize_size > 0 ? resize_size : 256;
+  if (L->mode <= 1 && (L->out_h != h || L->out_w != w)) { delete L; return nullptr; }
+  // mode 3: the shorter-side resize must cover the center crop (smaller
+  // values would replicate borders; torchvision CenterCrop zero-pads)
+  if (L->mode == 3 && L->resize_size < std::max(L->out_h, L->out_w)) {
+    delete L;
+    return nullptr;
+  }
   L->slots.resize(depth);
   for (auto& s : L->slots) {
-    s.xs.resize(size_t(batch) * L->sample_bytes());
+    s.xs.resize(size_t(batch) * L->out_sample_elems());
     s.ys.resize(batch);
   }
   return L;
+}
+
+void kl_set_norm(void* p, const float* mean, const float* stdev) {
+  auto* L = static_cast<Loader*>(p);
+  L->normalize = true;
+  for (int i = 0; i < 3 && i < L->c; i++) {
+    L->mean[i] = mean[i];
+    L->stdev[i] = stdev[i];
+  }
 }
 
 void kl_start_epoch(void* p, uint64_t seed) { static_cast<Loader*>(p)->start_epoch(seed); }
@@ -216,6 +407,58 @@ void kl_destroy(void* p) {
   auto* L = static_cast<Loader*>(p);
   L->stop_pool();
   delete L;
+}
+
+// One-shot threaded batch transform (no epoch machinery): apply mode 2 (rrc,
+// per-sample rng from seed^index) or mode 3 (centercrop) to n samples. For
+// eval paths that bring their own batching/masking (training/data.py::
+// eval_batches) but want the transform off the Python thread.
+int kl_transform(const void* x, int64_t n, int h, int w, int c, int in_dtype,
+                 float* out, int out_h, int out_w, int mode, int resize_size,
+                 const float* mean, const float* stdev, uint64_t seed,
+                 int threads) {
+  if (!x || !out || n <= 0 || (mode != 2 && mode != 3)) return 0;
+  if (in_dtype != 0 && in_dtype != 1) return 0;
+  if (mode == 3 && (resize_size > 0 ? resize_size : 256) < std::max(out_h, out_w))
+    return 0;
+  Loader L;
+  L.x = x;
+  L.n = n;
+  L.h = h; L.w = w; L.c = c;
+  L.out_h = out_h; L.out_w = out_w;
+  L.mode = mode;
+  L.resize_size = resize_size > 0 ? resize_size : 256;
+  L.in_dtype = in_dtype;
+  if (mean && stdev) {
+    L.normalize = true;
+    for (int i = 0; i < 3 && i < c; i++) {
+      L.mean[i] = mean[i];
+      L.stdev[i] = stdev[i];
+    }
+  }
+  const int64_t out_elems = L.out_sample_elems();
+  const int nt = std::max(1, int(std::min<int64_t>(threads, n)));
+  std::vector<std::thread> pool;
+  std::atomic<int64_t> next{0};
+  for (int t = 0; t < nt; t++) {
+    pool.emplace_back([&] {
+      for (;;) {
+        const int64_t i = next.fetch_add(1);
+        if (i >= n) return;
+        const void* sx = L.sample_ptr(i);
+        float* dx = out + i * out_elems;
+        if (mode == 3) {
+          L.fill_sample_centercrop(sx, dx);
+        } else {
+          uint64_t s = seed ^ (0xd1b54a32d192ed03ULL +
+                               uint64_t(i) * 0x9e3779b97f4a7c15ULL);
+          L.fill_sample_rrc(sx, dx, s);
+        }
+      }
+    });
+  }
+  for (auto& t : pool) t.join();
+  return 1;
 }
 
 }  // extern "C"

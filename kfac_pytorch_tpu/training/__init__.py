@@ -8,12 +8,18 @@ statistics/preconditioning and the SGD update — XLA schedules and overlaps
 every collective.
 """
 
-from kfac_pytorch_tpu.training.step import TrainState, make_eval_step, make_train_step
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    make_eval_step,
+    make_masked_eval_step,
+    make_train_step,
+)
 from kfac_pytorch_tpu.training.schedules import create_lr_schedule
 
 __all__ = [
     "TrainState",
     "make_train_step",
     "make_eval_step",
+    "make_masked_eval_step",
     "create_lr_schedule",
 ]

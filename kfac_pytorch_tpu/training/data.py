@@ -13,6 +13,7 @@ horizontal flip, the reference's transform_train) is vectorized numpy.
 
 from __future__ import annotations
 
+import math
 import os
 import pickle
 from typing import Iterator, Optional, Tuple
@@ -124,6 +125,66 @@ def eval_batches(
         yield x[take], y[take], mask
 
 
+def synthetic_cifar_like(
+    n_train: int = 50_000,
+    n_test: int = 10_000,
+    num_classes: int = 10,
+    size: int = 32,
+    prototypes_per_class: int = 4,
+    noise: float = 0.35,
+    seed: int = 0,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic, genuinely LEARNABLE CIFAR-shaped dataset.
+
+    This image is zero-egress and ships no datasets, so convergence
+    comparisons (K-FAC vs SGD per-epoch curves — the reference's headline
+    behavior, README.md:57-60) run on a procedural stand-in with real
+    structure: each class is a mixture of ``prototypes_per_class`` smoothed
+    random prototypes; each sample picks one, applies a random cyclic
+    translation (±25% of the image), horizontal flip, per-sample brightness/
+    contrast jitter, and additive pixel noise. Multi-modal classes +
+    translations make it non-linearly-separable (a template matcher fails on
+    shifts), so optimizers genuinely have to fit conv features — while the
+    generator stays a few lines of seeded numpy, reproducible anywhere.
+    Returns ``((x_train, y_train), (x_test, y_test))`` with normalized f32
+    NHWC images, the same interface as :func:`load_cifar10`.
+    """
+    rng = np.random.RandomState(seed)
+
+    # smoothed prototypes: low-res noise upsampled (structure at conv scale)
+    protos = np.empty((num_classes, prototypes_per_class, size, size, 3), np.float32)
+    low = size // 4
+    for c in range(num_classes):
+        for p in range(prototypes_per_class):
+            base = rng.randn(low, low, 3).astype(np.float32)
+            img = base.repeat(4, axis=0).repeat(4, axis=1)
+            # cheap separable blur to soften block edges
+            img = (img + np.roll(img, 1, 0) + np.roll(img, -1, 0)) / 3.0
+            img = (img + np.roll(img, 1, 1) + np.roll(img, -1, 1)) / 3.0
+            protos[c, p] = img
+
+    def make_split(n, split_seed):
+        r = np.random.RandomState(split_seed)
+        y = r.randint(0, num_classes, size=n).astype(np.int32)
+        pick = r.randint(0, prototypes_per_class, size=n)
+        x = protos[y, pick].copy()
+        max_shift = size // 4
+        dy = r.randint(-max_shift, max_shift + 1, size=n)
+        dx = r.randint(-max_shift, max_shift + 1, size=n)
+        flip = r.rand(n) < 0.5
+        bright = r.uniform(-0.3, 0.3, size=n).astype(np.float32)
+        contrast = r.uniform(0.8, 1.2, size=n).astype(np.float32)
+        for i in range(n):
+            img = np.roll(x[i], (dy[i], dx[i]), axis=(0, 1))
+            if flip[i]:
+                img = img[:, ::-1]
+            x[i] = img * contrast[i] + bright[i]
+        x += r.randn(n, size, size, 3).astype(np.float32) * noise
+        return x, y
+
+    return make_split(n_train, seed + 1), make_split(n_test, seed + 2)
+
+
 def synthetic_batches(
     batch_size: int,
     image_shape: Tuple[int, int, int],
@@ -146,6 +207,136 @@ def synthetic_batches(
         )
     for i in range(steps):
         yield pool[i % len(pool)]
+
+
+# ---------------------------------------------------------------------------
+# ImageNet transforms (numpy fallback for the native loader's modes 2/3)
+# ---------------------------------------------------------------------------
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _to_float(img: np.ndarray) -> np.ndarray:
+    """uint8 [0,255] → f32 [0,1]; float passes through (already preprocessed)."""
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+def _bilinear_window(
+    img: np.ndarray, oh: int, ow: int, oy: float, ox: float, sy: float, sx: float,
+    lo_y: float, hi_y: float, lo_x: float, hi_x: float,
+) -> np.ndarray:
+    """align_corners=False bilinear sample of one HWC image (vectorized).
+
+    Output pixel (r, c) reads source coordinate ((r+0.5)·sy − 0.5 + oy,
+    (c+0.5)·sx − 0.5 + ox) clamped per axis — the same parametrization as the
+    native kernel (loader.cpp::resize_crop), so both paths agree to float
+    rounding.
+    """
+    h, w = img.shape[:2]
+    fy = np.clip((np.arange(oh) + 0.5) * sy - 0.5 + oy, lo_y, hi_y)
+    fx = np.clip((np.arange(ow) + 0.5) * sx - 0.5 + ox, lo_x, hi_x)
+    y0 = fy.astype(np.int64)
+    x0 = fx.astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0).astype(np.float32)[:, None, None]
+    wx = (fx - x0).astype(np.float32)[None, :, None]
+    p00 = img[y0][:, x0]
+    p01 = img[y0][:, x1]
+    p10 = img[y1][:, x0]
+    p11 = img[y1][:, x1]
+    return (
+        p00 * (1 - wy) * (1 - wx)
+        + p01 * (1 - wy) * wx
+        + p10 * wy * (1 - wx)
+        + p11 * wy * wx
+    )
+
+
+def random_resized_crop_params(
+    h: int, w: int, rng: np.random.RandomState,
+    scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+):
+    """torchvision ``RandomResizedCrop.get_params``: 10 attempts of (area,
+    log-aspect) sampling, then the ratio-clamped center fallback (the
+    reference's train transform, pytorch_imagenet_resnet.py:154-166)."""
+    area = h * w
+    for _ in range(10):
+        target = rng.uniform(*scale) * area
+        ar = math.exp(rng.uniform(math.log(ratio[0]), math.log(ratio[1])))
+        cw = int(round(math.sqrt(target * ar)))
+        ch = int(round(math.sqrt(target / ar)))
+        if 0 < cw <= w and 0 < ch <= h:
+            i = rng.randint(0, h - ch + 1)
+            j = rng.randint(0, w - cw + 1)
+            return i, j, ch, cw
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = h, int(round(h * ratio[1]))
+    else:
+        cw, ch = w, h
+    return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+
+def imagenet_train_augment(
+    x: np.ndarray, out_size: int, rng: np.random.RandomState,
+    normalize: bool = True,
+) -> np.ndarray:
+    """RandomResizedCrop(out_size) + horizontal flip over a batch.
+
+    Numpy fallback for native mode 'rrc'; uint8 inputs are scaled to [0,1]
+    and normalized with the ImageNet stats (float inputs are assumed
+    pre-normalized, matching the f32-shard convention).
+    """
+    n = x.shape[0]
+    out = np.empty((n, out_size, out_size, x.shape[3]), np.float32)
+    for idx in range(n):
+        img = _to_float(x[idx])
+        h, w = img.shape[:2]
+        i, j, ch, cw = random_resized_crop_params(h, w, rng)
+        o = _bilinear_window(
+            img, out_size, out_size, float(i), float(j),
+            ch / out_size, cw / out_size, i, i + ch - 1, j, j + cw - 1,
+        )
+        if rng.rand() < 0.5:
+            o = o[:, ::-1]
+        out[idx] = o
+    if normalize and x.dtype == np.uint8:
+        out = (out - IMAGENET_MEAN) / IMAGENET_STD
+    return out
+
+
+def imagenet_eval_transform(
+    x: np.ndarray, out_size: int, resize_size: int = 256, normalize: bool = True
+) -> np.ndarray:
+    """Resize(shorter → resize_size) + CenterCrop(out_size) over a batch
+    (the reference's val transform, pytorch_imagenet_resnet.py:180-193)."""
+    if resize_size < out_size:
+        raise ValueError(
+            f"resize_size ({resize_size}) must cover the center crop "
+            f"({out_size}); smaller values would replicate borders instead "
+            "of torchvision CenterCrop's zero-padding"
+        )
+    n = x.shape[0]
+    out = np.empty((n, out_size, out_size, x.shape[3]), np.float32)
+    for idx in range(n):
+        img = _to_float(x[idx])
+        h, w = img.shape[:2]
+        scale = resize_size / min(h, w)
+        rh, rw = int(round(h * scale)), int(round(w * scale))
+        sy, sx = h / rh, w / rw
+        ty, tx = (rh - out_size) // 2, (rw - out_size) // 2
+        out[idx] = _bilinear_window(
+            img, out_size, out_size, ty * sy, tx * sx, sy, sx, 0, h - 1, 0, w - 1
+        )
+    if normalize and x.dtype == np.uint8:
+        out = (out - IMAGENET_MEAN) / IMAGENET_STD
+    return out
 
 
 # ---------------------------------------------------------------------------

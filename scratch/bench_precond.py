@@ -1,0 +1,76 @@
+"""Micro-bench: precondition path variants on real TPU, ResNet-50 shapes."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
+enable_persistent_cache()
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+from kfac_pytorch_tpu.ops import precondition as pc
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+# ResNet-50 (g=out, a=in(+1 for fc bias)) factor-space shapes
+shapes = []
+shapes.append((64, 148))           # conv1 7x7x3 (与bias? conv no bias) -> 147
+shapes += [(64, 64), (64, 576), (256, 64), (256, 64)]          # layer1 block1 (+downsample)
+shapes += [(64, 256), (64, 576), (256, 64)] * 2                # layer1 blocks 2-3
+shapes += [(128, 256), (128, 1152), (512, 128), (512, 256)]    # layer2 block1
+shapes += [(128, 512), (128, 1152), (512, 128)] * 3
+shapes += [(256, 512), (256, 2304), (1024, 256), (1024, 512)]  # layer3 block1
+shapes += [(256, 1024), (256, 2304), (1024, 256)] * 5
+shapes += [(512, 1024), (512, 4608), (2048, 512), (2048, 1024)]# layer4 block1
+shapes += [(512, 2048), (512, 4608), (2048, 512)] * 2
+shapes.append((1001, 2049))                                    # fc (+bias col)
+log(f"{len(shapes)} layers")
+
+rng = np.random.RandomState(0)
+gmats, eigen = {}, {}
+flops = 0
+for i, (g, a) in enumerate(shapes):
+    n = f"l{i}"
+    gmats[n] = jnp.asarray(rng.randn(g, a).astype(np.float32) * 0.01)
+    qa, _ = np.linalg.qr(rng.randn(a, a).astype(np.float32))
+    qg, _ = np.linalg.qr(rng.randn(g, g).astype(np.float32))
+    eigen[n] = {"QA": jnp.asarray(qa), "QG": jnp.asarray(qg),
+                "dA": jnp.asarray(np.abs(rng.randn(a)).astype(np.float32)),
+                "dG": jnp.asarray(np.abs(rng.randn(g)).astype(np.float32))}
+    flops += 4 * (g * g * a + g * a * a)
+log(f"precondition FLOPs: {flops/1e9:.1f} GFLOP (MACs x2)")
+
+damping = jnp.float32(1e-3)
+
+def perlayer(prec):
+    def f(gm):
+        return {n: pc.precondition_mat(gm[n], eigen[n]["QA"], eigen[n]["QG"],
+                                       eigen[n]["dA"], eigen[n]["dG"], damping, prec)
+                for n in gm}
+    return jax.jit(f)
+
+def batched(prec):
+    def f(gm):
+        return pc.precondition_all(gm, eigen, damping, prec)
+    return jax.jit(f)
+
+bf16_eigen = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), eigen)
+def batched_bf16(gm):
+    gmb = {n: v.astype(jnp.bfloat16) for n, v in gm.items()}
+    return pc.precondition_all(gmb, bf16_eigen, damping, lax.Precision.DEFAULT)
+batched_bf16 = jax.jit(batched_bf16)
+
+def timeit(name, fn, iters=30):
+    out = fn(gmats); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(gmats)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters * 1e3
+    log(f"{name}: {dt:.3f} ms")
+    return dt
+
+res = {}
+res["perlayer_highest"] = timeit("perlayer HIGHEST", perlayer(lax.Precision.HIGHEST))
+res["perlayer_high"] = timeit("perlayer HIGH", perlayer(lax.Precision.HIGH))
+res["batched_high"] = timeit("batched HIGH", batched(lax.Precision.HIGH))
+res["batched_default"] = timeit("batched DEFAULT(bf16)", batched(lax.Precision.DEFAULT))
+res["batched_bf16_storage"] = timeit("batched bf16 storage+compute", batched_bf16)
+print(json.dumps(res))

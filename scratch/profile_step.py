@@ -1,0 +1,125 @@
+"""Round-3 perf experiment: explain the r2 precond-only vs +factors inversion.
+
+Times each step variant two ways:
+  * blocking: block_until_ready every iter (r2 bench method)
+  * pipelined: dispatch all iters, block once (amortizes host/tunnel RTT)
+and reports mean/std over per-iter samples for the blocking mode.
+
+Optionally captures a jax.profiler trace (--trace DIR).
+"""
+import sys, os, time, json
+
+sys.path.insert(0, "/root/repo")
+from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(m):
+    print(f"[{time.perf_counter()-T0:7.1f}s] {m}", file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models import imagenet_resnet
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+batch, size = 32, 224
+devices = jax.devices()
+log(f"device={devices[0]}")
+
+model = imagenet_resnet.get_model("resnet50")
+rng = np.random.RandomState(0)
+images = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32))
+labels = jnp.asarray(rng.randint(0, 1000, size=batch).astype(np.int32))
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros_like(images), train=True)
+params, batch_stats = variables["params"], variables.get("batch_stats", {})
+tx = make_sgd(momentum=0.9, weight_decay=5e-5)
+
+
+def fresh_state(kfac):
+    p = jax.tree_util.tree_map(jnp.copy, params)
+    bs = jax.tree_util.tree_map(jnp.copy, batch_stats)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=p,
+        batch_stats=bs,
+        opt_state=tx.init(p),
+        kfac_state=kfac.init(p) if kfac else None,
+    )
+
+
+lr, damping = jnp.float32(0.1), jnp.float32(0.001)
+sgd_step = make_train_step(model, tx, None, train_kwargs={"train": True})
+kfac = KFAC(damping=0.001, fac_update_freq=10, kfac_update_freq=100)
+kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+
+
+def variant(name, uf, ue):
+    if name == "sgd":
+        def f(state):
+            s, _ = sgd_step(state, (images, labels), lr, damping)
+            return s
+    else:
+        def f(state):
+            s, _ = kfac_step(state, (images, labels), lr, damping,
+                             update_factors=uf, update_eigen=ue)
+            return s
+    return f
+
+
+def time_both(name, stepf, state, iters=30):
+    log(f"{name}: warmup/compile")
+    for _ in range(3):
+        state = stepf(state)
+    state = jax.block_until_ready(state)
+    # blocking per-iter samples
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state = jax.block_until_ready(stepf(state))
+        samples.append(time.perf_counter() - t0)
+    samples = np.array(samples)
+    # pipelined: dispatch all, block once
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = stepf(state)
+    state = jax.block_until_ready(state)
+    piped = (time.perf_counter() - t0) / iters
+    log(f"{name}: blocking mean {samples.mean()*1e3:.2f} ms std {samples.std()*1e3:.2f} "
+        f"min {samples.min()*1e3:.2f} max {samples.max()*1e3:.2f} | pipelined {piped*1e3:.2f} ms")
+    return dict(name=name, block_mean=samples.mean()*1e3, block_std=samples.std()*1e3,
+                block_min=samples.min()*1e3, piped=piped*1e3), state
+
+
+results = []
+r, _ = time_both("sgd", variant("sgd", False, False), fresh_state(None))
+results.append(r)
+
+log("kfac: populate eigen state (full step once)")
+s = variant("kfac", True, True)(fresh_state(kfac))
+s = jax.block_until_ready(s)
+r, s = time_both("kfac-precond", variant("kfac", False, False), s)
+results.append(r)
+r, s = time_both("kfac+factors", variant("kfac", True, False), s)
+results.append(r)
+r, s = time_both("kfac+eigen", variant("kfac", True, True), s, iters=6)
+results.append(r)
+
+if "--trace" in sys.argv:
+    tdir = sys.argv[sys.argv.index("--trace") + 1]
+    log(f"tracing precond-only + factors into {tdir}")
+    with jax.profiler.trace(tdir):
+        for _ in range(6):
+            s = variant("kfac", False, False)(s)
+        s = jax.block_until_ready(s)
+        for _ in range(6):
+            s = variant("kfac", True, False)(s)
+        s = jax.block_until_ready(s)
+
+print(json.dumps(results, indent=1))

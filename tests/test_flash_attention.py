@@ -39,13 +39,64 @@ def test_short_sequence_falls_back():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-def test_gradients_flow_through_kernel():
-    """custom VJP: training differentiates through the fused forward; grads
-    must equal the exact path's."""
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_flow_through_kernel(causal):
+    """Fused blockwise backward: dq/dk/dv must equal the exact path's."""
     q, k, v = _qkv(b=1, t=128, h=2, d=32, seed=4)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def loss_exact(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_multi_block_uneven():
+    """Backward across multiple q AND k blocks with block_q != block_k."""
+    q, k, v = _qkv(b=1, t=512, h=1, d=32, seed=5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=256, interpret=True
+            )
+            * jnp.cos(jnp.arange(v.shape[-1]))
+        )
+
+    def loss_exact(q, k, v):
+        return jnp.sum(
+            full_attention(q, k, v, causal=True) * jnp.cos(jnp.arange(v.shape[-1]))
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+_on_tpu = jax.devices()[0].platform == "tpu"
+
+
+@pytest.mark.skipif(not _on_tpu, reason="needs a real TPU (Mosaic compile)")
+def test_tpu_hardware_forward():
+    """The kernel through Mosaic on a real chip, vs the exact jnp path."""
+    q, k, v = _qkv(b=2, t=512, h=4, d=64, seed=6)
+    out = flash_attention(q, k, v, causal=True)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.skipif(not _on_tpu, reason="needs a real TPU (Mosaic compile)")
+def test_tpu_hardware_backward():
+    q, k, v = _qkv(b=1, t=512, h=2, d=64, seed=7)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
 
     def loss_exact(q, k, v):
         return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
@@ -53,7 +104,9 @@ def test_gradients_flow_through_kernel():
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     ge = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, ge):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
+        )
 
 
 def test_best_attention_fn_dispatch():

@@ -85,6 +85,148 @@ def test_augment_is_valid_padded_crop():
         assert found, f"sample {i} is not any (crop, flip) of its padded source"
 
 
+def _np_bilinear_resize(img, oh, ow):
+    """align_corners=False bilinear resize, the torchvision/PIL convention."""
+    h, w, c = img.shape
+    out = np.empty((oh, ow, c), np.float32)
+    for r in range(oh):
+        fy = np.clip((r + 0.5) * h / oh - 0.5, 0, h - 1)
+        y0 = int(fy); y1 = min(y0 + 1, h - 1); wy = fy - y0
+        for col in range(ow):
+            fx = np.clip((col + 0.5) * w / ow - 0.5, 0, w - 1)
+            x0 = int(fx); x1 = min(x0 + 1, w - 1); wx = fx - x0
+            out[r, col] = (
+                img[y0, x0] * (1 - wy) * (1 - wx)
+                + img[y0, x1] * (1 - wy) * wx
+                + img[y1, x0] * wy * (1 - wx)
+                + img[y1, x1] * wy * wx
+            )
+    return out
+
+
+def test_centercrop_matches_numpy_reference():
+    """Eval transform: Resize(shorter→resize_size) + CenterCrop(out), uint8
+    in, normalized f32 out — vs a from-scratch numpy implementation."""
+    r = np.random.RandomState(3)
+    n, h, w = 4, 40, 32
+    x = r.randint(0, 256, size=(n, h, w, 3), dtype=np.uint8)
+    y = np.arange(n, dtype=np.int32)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    loader = NativeEpochLoader(
+        x, y, n, shuffle=False, mode="centercrop", out_size=(24, 24),
+        resize_size=28, mean=mean, std=std,
+    )
+    (xb, _), = list(loader.epoch(0))
+    loader.close()
+    # numpy reference: shorter side (w=32) → 28, so 40x32 → 35x28, crop 24x24
+    scale = 28 / 32
+    rh, rw = round(h * scale), round(w * scale)
+    for i in range(n):
+        resized = _np_bilinear_resize(x[i].astype(np.float32) / 255.0, rh, rw)
+        t0, l0 = (rh - 24) // 2, (rw - 24) // 2
+        want = (resized[t0 : t0 + 24, l0 : l0 + 24] - mean) / std
+        # the native path folds the crop offset into one bilinear pass, which
+        # is mathematically identical to resize-then-crop — only float
+        # rounding differs
+        np.testing.assert_allclose(xb[i], want, rtol=1e-4, atol=1e-4)
+
+
+def test_rrc_shapes_determinism_and_distribution():
+    """Train transform: output geometry, thread-count invariance, flip rate
+    ~0.5 and crop scale within [0.08, 1] (the torchvision parameter ranges)."""
+    r = np.random.RandomState(4)
+    n, h, w, out = 256, 32, 32, 16
+    x = r.randint(0, 256, size=(n, h, w, 3), dtype=np.uint8)
+    y = np.arange(n, dtype=np.int32)
+
+    def run(workers):
+        loader = NativeEpochLoader(
+            x, y, n, shuffle=False, mode="rrc", out_size=(out, out),
+            num_workers=workers,
+        )
+        (xb, yb), = list(loader.epoch(9))
+        loader.close()
+        return xb, yb
+
+    xb1, _ = run(1)
+    xb4, _ = run(4)
+    assert xb1.shape == (n, out, out, 3)
+    np.testing.assert_array_equal(xb1, xb4)  # deterministic across threads
+    assert xb1.min() >= 0.0 and xb1.max() <= 1.0  # u8→[0,1] range preserved
+    # different seeds give different crops
+    loader = NativeEpochLoader(x, y, n, shuffle=False, mode="rrc", out_size=(out, out))
+    (xb_other, _), = list(loader.epoch(10))
+    loader.close()
+    assert not np.array_equal(xb1, xb_other)
+
+
+def test_rrc_identity_when_crop_is_full_image():
+    """A crop covering the full source at out_size == source size must be the
+    identity (bilinear with unit scale) — catches interpolation off-by-ones."""
+    # constant-channel images: any crop/resize of them is the same constant,
+    # so we can assert exact values regardless of the sampled window
+    vals = np.arange(8, dtype=np.float32)[:, None, None, None]
+    x = np.broadcast_to(vals, (8, 16, 16, 3)).copy()
+    y = np.arange(8, dtype=np.int32)
+    loader = NativeEpochLoader(x, y, 8, shuffle=False, mode="rrc", out_size=(16, 16))
+    (xb, yb), = list(loader.epoch(2))
+    loader.close()
+    for i in range(8):
+        np.testing.assert_allclose(xb[i], np.full((16, 16, 3), yb[i]), atol=1e-6)
+
+
+def test_centercrop_matches_numpy_fallback():
+    """training.data.imagenet_eval_transform (the no-toolchain fallback) and
+    the native centercrop path must agree to float rounding."""
+    r = np.random.RandomState(7)
+    x = r.randint(0, 256, size=(3, 50, 36, 3), dtype=np.uint8)
+    y = np.arange(3, dtype=np.int32)
+    loader = NativeEpochLoader(
+        x, y, 3, shuffle=False, mode="centercrop", out_size=(24, 24),
+        resize_size=30, mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD,
+    )
+    (xb, _), = list(loader.epoch(0))
+    loader.close()
+    want = data_lib.imagenet_eval_transform(x, 24, resize_size=30)
+    np.testing.assert_allclose(xb, want, rtol=1e-4, atol=1e-4)
+
+
+def test_native_transform_oneshot_matches_fallback():
+    """kl_transform (threaded one-shot, the eval-loop path) must equal the
+    numpy fallback exactly; rrc mode must be deterministic in (seed, index)."""
+    from kfac_pytorch_tpu.runtime import native_transform
+
+    r = np.random.RandomState(8)
+    x = r.randint(0, 256, size=(5, 48, 40, 3), dtype=np.uint8)
+    got = native_transform(
+        x, (32, 32), mode="centercrop", resize_size=36,
+        mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD,
+    )
+    want = data_lib.imagenet_eval_transform(x, 32, resize_size=36)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    a = native_transform(x, (32, 32), mode="rrc", seed=5, num_workers=1)
+    b = native_transform(x, (32, 32), mode="rrc", seed=5, num_workers=3)
+    c = native_transform(x, (32, 32), mode="rrc", seed=6)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_uint8_normalize_passthrough():
+    """mode='none' with uint8 input: out == (x/255 - mean)/std exactly."""
+    r = np.random.RandomState(5)
+    x = r.randint(0, 256, size=(8, 6, 6, 3), dtype=np.uint8)
+    y = np.arange(8, dtype=np.int32)
+    mean = np.array([0.5, 0.4, 0.3], np.float32)
+    std = np.array([0.2, 0.3, 0.4], np.float32)
+    loader = NativeEpochLoader(x, y, 8, shuffle=False, mode="none", mean=mean, std=std)
+    (xb, _), = list(loader.epoch(0))
+    loader.close()
+    want = (x.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(xb, want, rtol=1e-6, atol=1e-6)
+
+
 def test_reusable_epochs_reshuffle():
     x, _ = _dataset(n=32)
     y = np.arange(32, dtype=np.int32)

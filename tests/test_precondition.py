@@ -46,6 +46,29 @@ def test_precondition_identity_factors_is_scaled_identity():
     np.testing.assert_allclose(out, grad / 1.5, atol=1e-5)
 
 
+def test_precondition_all_matches_per_layer():
+    """Batched same-shape grouping must equal the per-layer reference path."""
+    rng = np.random.RandomState(5)
+    gmats, eigen = {}, {}
+    # three layers share shape (4, 5); two others are unique
+    for i, (ng, na) in enumerate([(4, 5), (4, 5), (4, 5), (3, 7), (6, 2)]):
+        name = f"l{i}"
+        q_a, d_a = eigh_ops.eigh_with_floor(jnp.asarray(_rand_spd(na, 10 + i)))
+        q_g, d_g = eigh_ops.eigh_with_floor(jnp.asarray(_rand_spd(ng, 20 + i)))
+        gmats[name] = jnp.asarray(rng.randn(ng, na).astype(np.float32))
+        eigen[name] = {"QA": q_a, "dA": d_a, "QG": q_g, "dG": d_g}
+    damping = jnp.float32(0.02)
+    got = pc.precondition_all(gmats, eigen, damping)
+    for name in gmats:
+        e = eigen[name]
+        want = pc.precondition_mat(
+            gmats[name], e["QA"], e["QG"], e["dA"], e["dG"], damping
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_kl_clip_no_clipping_when_small():
     ups = {"l1": jnp.full((2, 2), 1e-4)}
     grads = {"l1": jnp.full((2, 2), 1e-4)}

@@ -175,6 +175,42 @@ def test_sharded_eigen_matches_replicated():
                                    np.asarray(s_sh["eigen"][n]["dA"]), atol=1e-5)
 
 
+def test_sharded_eigen_2d_mesh_spans_whole_mesh():
+    """On a data×seq mesh, eigh work must shard over ALL devices (flat
+    indices), not replicate per seq row — results equal the replicated path
+    and the assignment table actually uses every device."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(6)
+    params = _dense_params(rng, [6, 5, 4, 3, 2])
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    devices = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "seq"))
+    kfac_sh = KFAC(damping=0.01, mesh=mesh)
+    assert kfac_sh._world() == 8  # whole mesh, not mesh.shape['data'] == 4
+    names = list(params.keys())
+    table = layer_assignment(
+        names, {n: False for n in names}, kfac_sh._world(), None, 1
+    )
+    used = {r for t in table.values() for k in ("A", "G") for r in t[k]}
+    assert max(used) >= 4, f"owners never exceed the data axis: {sorted(used)}"
+
+    g_sh, s_sh = kfac_sh.update(grads, kfac_sh.init(params), a_contribs=a_c,
+                                g_factor_stats=g_s, lr=0.1, damping=0.01,
+                                update_factors=True, update_eigen=True)
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, s_rep = kfac_rep.update(grads, kfac_rep.init(params), a_contribs=a_c,
+                                   g_factor_stats=g_s, lr=0.1, damping=0.01,
+                                   update_factors=True, update_eigen=True)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_sh[n]["kernel"]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s_rep["eigen"][n]["dA"]),
+                                   np.asarray(s_sh["eigen"][n]["dA"]), atol=1e-5)
+
+
 def test_sharded_eigen_distribute_layer_factors_matches():
     rng = np.random.RandomState(4)
     params = _dense_params(rng, [6, 5, 4])
