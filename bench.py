@@ -113,12 +113,14 @@ def _timeit(step, state, warmup=2, iters=20, windows=3, label=""):
     return float(np.mean(times)), float(np.std(times)), state
 
 
-def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag=""):
+def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
+                 kfac_kwargs=None):
     """Measure SGD + the three K-FAC step variants for one compute dtype."""
     from kfac_pytorch_tpu import KFAC
     from kfac_pytorch_tpu.models import imagenet_resnet
     from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
 
+    kfac_kwargs = kfac_kwargs or {}
     model = imagenet_resnet.get_model("resnet50", dtype=dtype)
     rng = np.random.RandomState(0)
     images = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32))
@@ -147,7 +149,8 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag=""):
         s, _ = sgd_step(state, (images, labels), lr, damping)
         return s
 
-    kfac = KFAC(damping=0.001, fac_update_freq=fac_freq, kfac_update_freq=kfac_freq)
+    kfac = KFAC(damping=0.001, fac_update_freq=fac_freq,
+                kfac_update_freq=kfac_freq, **kfac_kwargs)
     kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
 
     def run_kfac(uf, ue):
@@ -219,6 +222,20 @@ def main():
     except Exception as e:  # noqa: BLE001 — bf16 arm is informational
         _log(f"bf16 arm failed: {type(e).__name__}: {e}")
         bf16 = None
+    try:
+        # aggressive K-FAC numerics: 1-pass-bf16 rotations + bf16-stored
+        # eigenvectors (convergence-validated on the CIFAR curves,
+        # docs/PERF.md); model compute stays f32
+        from jax import lax
+
+        aggr = _measure_arm(
+            batch, size, fac_freq, kfac_freq, dtype=None, tag="-aggr",
+            kfac_kwargs=dict(precond_precision=lax.Precision.DEFAULT,
+                             eigen_dtype=jnp.bfloat16),
+        )
+    except Exception as e:  # noqa: BLE001
+        _log(f"aggressive arm failed: {type(e).__name__}: {e}")
+        aggr = None
 
     overhead_pct = f32["overhead_pct"]
     print(
@@ -234,6 +251,7 @@ def main():
                     "timing": "pipelined (dispatch N, block once), 3x20-iter windows",
                     "f32": f32,
                     "bf16": bf16,
+                    "kfac_aggressive_numerics": aggr,
                 },
             }
         )

@@ -92,6 +92,13 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--precond-precision", default=None,
+                   choices=["default", "high", "highest"],
+                   help="matmul precision of the every-step eigenbasis "
+                        "rotations (docs/PERF.md); None = library default")
+    p.add_argument("--eigen-dtype", default="f32", choices=["f32", "bf16"],
+                   help="storage dtype of the eigenvector matrices (bf16 "
+                        "halves the dominant precondition HBM stream)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -146,6 +153,8 @@ def main(argv=None):
             diag_warmup=args.diag_warmup,
             distribute_layer_factors=args.distribute_layer_factors,
             mesh=mesh if world > 1 else None,
+            precond_precision=args.precond_precision,
+            eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
         )
         kfac_sched = KFACParamScheduler(
             kfac,

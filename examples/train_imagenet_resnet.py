@@ -97,6 +97,13 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--precond-precision", default=None,
+                   choices=["default", "high", "highest"],
+                   help="matmul precision of the every-step eigenbasis "
+                        "rotations (docs/PERF.md); None = library default")
+    p.add_argument("--eigen-dtype", default="f32", choices=["f32", "bf16"],
+                   help="storage dtype of the eigenvector matrices (bf16 "
+                        "halves the dominant precondition HBM stream)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -163,6 +170,8 @@ def main(argv=None):
             diag_warmup=args.diag_warmup,
             distribute_layer_factors=args.distribute_layer_factors,
             mesh=mesh if world > 1 else None,
+            precond_precision=args.precond_precision,
+            eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
         )
 
     state = TrainState(
@@ -238,10 +247,11 @@ def main(argv=None):
         steps_per_epoch = len(x_train) // (global_bs * accum)
         # the reference train stack is RandomResizedCrop(size)+flip
         # (pytorch_imagenet_resnet.py:154-166); without augmentation,
-        # same-size float shards pass through and anything else center-crops
+        # same-size shards pass through (uint8 still decodes+normalizes in
+        # mode 'none') and anything else center-crops
         if augment:
             train_mode = "rrc"
-        elif stored == (im, im) and not uint8:
+        elif stored == (im, im):
             train_mode = "none"
         else:
             train_mode = "centercrop"
@@ -296,6 +306,12 @@ def main(argv=None):
                         xb = data_lib.imagenet_eval_transform(
                             xb, im, resize_size=args.val_resize
                         )
+                    elif xb.dtype == np.uint8:
+                        # pass-through still decodes + normalizes uint8
+                        xb = (
+                            np.asarray(xb, np.float32) / 255.0
+                            - data_lib.IMAGENET_MEAN
+                        ) / data_lib.IMAGENET_STD
                     else:
                         xb = np.asarray(xb, np.float32)
                     yield xb, yb
@@ -345,9 +361,11 @@ def main(argv=None):
             # full-split masked eval; jitted sums are already pod-global
             local_val_bs = args.val_batch_size * world // n_proc
             vl_sum = vc_sum = vn = 0.0
-            val_passthrough = (
-                tuple(x_val.shape[1:3]) == (im, im) and x_val.dtype != np.uint8
-            )
+            # shards already stored at the crop size pass through (uint8
+            # still decodes+normalizes) — they were transformed at staging;
+            # re-running Resize+CenterCrop would zoom-crop them a second
+            # time. Mirrors the train-side stored==(im,im) case.
+            val_passthrough = tuple(x_val.shape[1:3]) == (im, im)
             val_norm = (
                 dict(mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD)
                 if x_val.dtype == np.uint8 else {}
@@ -360,7 +378,13 @@ def main(argv=None):
                 # pytorch_imagenet_resnet.py:180-193); native threaded
                 # transform when available, per-image numpy otherwise
                 if val_passthrough:
-                    xb = np.asarray(xb, np.float32)
+                    if xb.dtype == np.uint8:
+                        xb = (
+                            np.asarray(xb, np.float32) / 255.0
+                            - data_lib.IMAGENET_MEAN
+                        ) / data_lib.IMAGENET_STD
+                    else:
+                        xb = np.asarray(xb, np.float32)
                 elif use_native:
                     xb = runtime.native_transform(
                         xb, (im, im), mode="centercrop",

@@ -9,7 +9,7 @@ schedule the reduction with everything else (no host sync).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -53,28 +53,79 @@ def precondition_mat(
     )
 
 
+def shape_groups(
+    shapes: Dict[str, Tuple[int, int]]
+) -> Dict[Tuple[int, int], list]:
+    """Group layer names by exact ``[out, in]`` shape, insertion-ordered.
+
+    The single source of truth for batching order: both the eigen-time
+    stacking (:func:`stack_eigen`) and the per-step batched preconditioning
+    derive their row order from this, so they can never disagree.
+    """
+    groups: Dict[Tuple[int, int], list] = {}
+    for name, shape in shapes.items():
+        groups.setdefault(tuple(shape), []).append(name)
+    return groups
+
+
+def split_eigen_state(
+    eigen: Dict[str, Dict[str, jnp.ndarray]],
+) -> Tuple[Dict[str, Dict[str, jnp.ndarray]], Dict[str, Dict[str, jnp.ndarray]]]:
+    """Split a full per-layer eigen dict into (singletons, stacked groups).
+
+    Same-shape layers are STACKED for the batched rotations and stored ONLY
+    in that form — splitting (rather than duplicating) matters twice over:
+    the Q matrices are the dominant HBM stream of the every-step path
+    (~480 MB f32 on ResNet-50), so (a) re-stacking per step would double
+    that traffic for ~99 of every 100 steps (stacks rebuild only when the
+    eigendecompositions change, every ``kfac_update_freq`` steps), and (b)
+    carrying both forms would double K-FAC state and checkpoint size.
+    Singleton-shape layers stay per-layer (no stack copy needed). Stack keys
+    are ``"{out}x{in}"`` (pytree-safe); row order within a stack is the
+    insertion order of :func:`shape_groups`, which the per-step grad
+    stacking in :func:`precondition_all` re-derives identically.
+    """
+    shapes = {
+        n: (e["QG"].shape[0], e["QA"].shape[0]) for n, e in eigen.items()
+    }
+    singles: Dict[str, Dict[str, jnp.ndarray]] = {}
+    stacked: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for (g, a), names in shape_groups(shapes).items():
+        if len(names) < 2:
+            singles[names[0]] = eigen[names[0]]
+            continue
+        stacked[f"{g}x{a}"] = {
+            "QA": jnp.stack([eigen[n]["QA"] for n in names]),
+            "QG": jnp.stack([eigen[n]["QG"] for n in names]),
+            "dA": jnp.stack([eigen[n]["dA"] for n in names]),
+            "dG": jnp.stack([eigen[n]["dG"] for n in names]),
+        }
+    return singles, stacked
+
+
 def precondition_all(
     grad_mats: Dict[str, jnp.ndarray],
     eigen: Dict[str, Dict[str, jnp.ndarray]],
     damping: jnp.ndarray,
     precision: lax.Precision = _ROTATION_PRECISION,
+    stacked: Optional[Dict[str, Dict[str, jnp.ndarray]]] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Precondition every layer's gradient matrix, batching same-shape layers.
 
     The per-layer loop hands XLA ~54 sequential small triple-matmul chains on
     ResNet-50 — each too small to fill the MXU. Layers whose ``[out, in]``
     shapes coincide (bottleneck blocks repeat identical shapes 3-6x) are
-    stacked and preconditioned with ONE batched einsum chain instead; results
-    come back keyed as given. Exact-shape grouping keeps the math bit-identical
-    to :func:`precondition_mat` (no padding; matmul has no per-shape compile
-    cliff to bucket around, unlike eigh — see ops/eigh.py).
+    preconditioned with ONE batched einsum chain instead; results come back
+    keyed as given. Exact-shape grouping keeps the math bit-identical to
+    :func:`precondition_mat` (no padding; matmul has no per-shape compile
+    cliff to bucket around, unlike eigh — see ops/eigh.py). ``stacked``
+    (from :func:`split_eigen_state`, carried in KFAC state) supplies the
+    group eigen tensors pre-stacked; a group absent from ``stacked`` is
+    stacked on the fly from per-layer entries (legacy full-format states).
     """
-    groups: Dict[Tuple[int, int], list] = {}
-    for name, g in grad_mats.items():
-        groups.setdefault(g.shape, []).append(name)
-
+    shapes = {name: g.shape for name, g in grad_mats.items()}
     out: Dict[str, jnp.ndarray] = {}
-    for shape, names in groups.items():
+    for (go, ai), names in shape_groups(shapes).items():
         if len(names) == 1:
             name = names[0]
             e = eigen[name]
@@ -84,10 +135,15 @@ def precondition_all(
             )
             continue
         gm = jnp.stack([grad_mats[n] for n in names])  # [k, out, in]
-        qa = jnp.stack([eigen[n]["QA"] for n in names])  # [k, in, in]
-        qg = jnp.stack([eigen[n]["QG"] for n in names])  # [k, out, out]
-        da = jnp.stack([eigen[n]["dA"] for n in names])  # [k, in]
-        dg = jnp.stack([eigen[n]["dG"] for n in names])  # [k, out]
+        key = f"{go}x{ai}"
+        if stacked is not None and key in stacked:
+            s = stacked[key]
+            qa, qg, da, dg = s["QA"], s["QG"], s["dA"], s["dG"]
+        else:
+            qa = jnp.stack([eigen[n]["QA"] for n in names])  # [k, in, in]
+            qg = jnp.stack([eigen[n]["QG"] for n in names])  # [k, out, out]
+            da = jnp.stack([eigen[n]["dA"] for n in names])  # [k, in]
+            dg = jnp.stack([eigen[n]["dG"] for n in names])  # [k, out]
         v1 = jnp.einsum("kji,kjl->kil", qg, gm, precision=precision)
         v1 = jnp.einsum("kil,klm->kim", v1, qa, precision=precision)
         v2 = v1 / (dg[:, :, None] * da[:, None, :] + damping)

@@ -103,6 +103,7 @@ class KFAC:
         eps: float = 1e-10,
         layers: Optional[list] = None,
         precond_precision: Optional[Any] = None,
+        eigen_dtype: Any = jnp.float32,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -139,7 +140,23 @@ class KFAC:
         self.layers = list(layers) if layers is not None else None
         # Precision of the every-step eigenbasis rotations (see
         # ops/precondition.py::_ROTATION_PRECISION for the default and why).
+        # Accepts a lax.Precision or the strings 'default'/'high'/'highest'.
+        if isinstance(precond_precision, str):
+            from jax import lax
+
+            precond_precision = {
+                "default": lax.Precision.DEFAULT,
+                "high": lax.Precision.HIGH,
+                "highest": lax.Precision.HIGHEST,
+            }[precond_precision.lower()]
         self.precond_precision = precond_precision
+        # Storage dtype for the eigenVECTOR matrices (QA/QG) — the dominant
+        # HBM stream of the every-step precondition path (~480 MB f32 read
+        # twice per step on ResNet-50). bf16 halves that traffic; orthonormal
+        # Q entries are O(1/√n) and well-conditioned, and eigenVALUES (the
+        # damped divide) stay f32 regardless. Validated by the CIFAR
+        # convergence runs (docs/PERF.md).
+        self.eigen_dtype = eigen_dtype
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -202,15 +219,19 @@ class KFAC:
                 "G": jnp.eye(g_side, dtype=jnp.float32),
             }
             eigen[name] = {
-                "QA": jnp.zeros((a_side, a_side), jnp.float32),
+                "QA": jnp.zeros((a_side, a_side), self.eigen_dtype),
                 "dA": jnp.zeros((a_side,), jnp.float32),
-                "QG": jnp.zeros((g_side, g_side), jnp.float32),
+                "QG": jnp.zeros((g_side, g_side), self.eigen_dtype),
                 "dG": jnp.zeros((g_side,), jnp.float32),
             }
+        # same-shape groups live ONLY pre-stacked (batched-rotation form);
+        # singleton shapes stay per-layer — see split_eigen_state
+        singles, stacked = precond_ops.split_eigen_state(eigen)
         return {
             "step": jnp.zeros((), jnp.int32),
             "factors": facs,
-            "eigen": eigen,
+            "eigen": singles,
+            "eigen_stacked": stacked,
         }
 
     # ------------------------------------------------------------------
@@ -288,6 +309,7 @@ class KFAC:
             }
 
         eigen = state["eigen"]
+        stacked = state.get("eigen_stacked")
         if update_eigen:
             # diag_warmup: use 1 block until `epoch >= diag_warmup`
             # (kfac_preconditioner.py:361-367), via the static flag.
@@ -309,6 +331,19 @@ class KFAC:
                     name: (diag_blocks if is_conv[name] else 1) for name in names
                 }
                 eigen = replicated_eigen_update(facs, blocks, self.eps)
+            if self.eigen_dtype != jnp.float32:
+                # eigh itself always runs f32; only the stored/streamed Q
+                # matrices downcast (eigenvalues stay f32 for the divide)
+                eigen = {
+                    n: {
+                        "QA": e["QA"].astype(self.eigen_dtype),
+                        "QG": e["QG"].astype(self.eigen_dtype),
+                        "dA": e["dA"],
+                        "dG": e["dG"],
+                    }
+                    for n, e in eigen.items()
+                }
+            eigen, stacked = precond_ops.split_eigen_state(eigen)
 
         # Precondition every layer's gradient, every step
         # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
@@ -319,10 +354,12 @@ class KFAC:
         }
         if self.precond_precision is not None:
             updates = precond_ops.precondition_all(
-                gmats, eigen, damping, self.precond_precision
+                gmats, eigen, damping, self.precond_precision, stacked=stacked
             )
         else:
-            updates = precond_ops.precondition_all(gmats, eigen, damping)
+            updates = precond_ops.precondition_all(
+                gmats, eigen, damping, stacked=stacked
+            )
 
         # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
         nu = precond_ops.kl_clip_coefficient(
@@ -334,5 +371,6 @@ class KFAC:
             "step": state["step"] + 1,
             "factors": facs,
             "eigen": eigen,
+            "eigen_stacked": stacked,
         }
         return new_grads, new_state

@@ -175,9 +175,16 @@ class NativeEpochLoader:
         )
         if not self._ptr:
             raise RuntimeError("kl_create failed")
+        if std is not None and mean is None:
+            raise ValueError("std given without mean — pass both or neither")
         if mean is not None:
             m = np.ascontiguousarray(mean, np.float32)
             s = np.ascontiguousarray(std if std is not None else [1, 1, 1], np.float32)
+            if len(m) != min(c, 3) or len(s) != len(m):
+                raise ValueError(
+                    f"normalization needs {min(c, 3)} per-channel values; "
+                    f"got mean[{len(m)}], std[{len(s)}]"
+                )
             lib.kl_set_norm(self._ptr, m.ctypes.data, s.ctypes.data)
 
     def epoch(self, seed: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -233,6 +240,8 @@ def native_transform(
         raise RuntimeError("native loader unavailable (no C++ toolchain?)")
     if mode not in ("rrc", "centercrop"):
         raise ValueError(f"unsupported one-shot mode {mode!r}")
+    if std is not None and mean is None:
+        raise ValueError("std given without mean — pass both or neither")
     if x.dtype == np.uint8:
         in_dtype = 1
         xc = x if x.flags["C_CONTIGUOUS"] else np.ascontiguousarray(x)
@@ -244,6 +253,11 @@ def native_transform(
     out = np.empty((n, oh, ow, c), np.float32)
     m = np.ascontiguousarray(mean, np.float32) if mean is not None else None
     s = np.ascontiguousarray(std if std is not None else [1, 1, 1], np.float32)
+    if m is not None and (len(m) != min(c, 3) or len(s) != len(m)):
+        raise ValueError(
+            f"normalization needs {min(c, 3)} per-channel values; "
+            f"got mean[{len(m)}], std[{len(s)}]"
+        )
     ok = lib.kl_transform(
         xc.ctypes.data, n, h, w, c, in_dtype,
         out.ctypes.data, oh, ow, MODES[mode], resize_size,

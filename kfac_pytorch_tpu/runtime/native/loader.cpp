@@ -107,7 +107,9 @@ struct Loader {
   }
 
   inline float norm_px(float v, int ch) const {
-    return normalize ? (v - mean[ch]) / stdev[ch] : v;
+    // mean/stdev hold 3 channels; channels beyond that pass through
+    // (the Python binding rejects c != len(mean) up front)
+    return (normalize && ch < 3) ? (v - mean[ch]) / stdev[ch] : v;
   }
 
   const void* sample_ptr(int64_t src) const {

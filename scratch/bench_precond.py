@@ -3,11 +3,20 @@ import sys, time, json
 sys.path.insert(0, "/root/repo")
 from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
 enable_persistent_cache()
+import time as _t
 import jax, jax.numpy as jnp, numpy as np
 from jax import lax
 from kfac_pytorch_tpu.ops import precondition as pc
 
 def log(m): print(m, file=sys.stderr, flush=True)
+
+# wait out a wedged TPU lease (killed prior claim-holder)
+for _i in range(40):
+    try:
+        jax.devices(); break
+    except RuntimeError as e:
+        log(f"TPU unavailable ({str(e)[:80]}); retry {_i}")
+        _t.sleep(30)
 
 # ResNet-50 (g=out, a=in(+1 for fc bias)) factor-space shapes
 shapes = []

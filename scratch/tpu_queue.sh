@@ -1,0 +1,75 @@
+#!/bin/bash
+# Serial TPU work queue for round 3. NO kills/timeouts on TPU processes —
+# SIGTERM wedges the axon lease for 30+ minutes. Each phase logs to its own
+# file; the script records phase completion in /tmp/tpu_queue.status.
+set -u
+cd /root/repo
+STATUS=/tmp/tpu_queue.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+log "queue start"
+
+# 0. micro-bench (if the standalone run never finished, rerun here)
+if ! grep -q "perlayer_highest" /tmp/bench_precond.out 2>/dev/null; then
+  log "phase0 bench_precond start"
+  python scratch/bench_precond.py > /tmp/bench_precond.out 2>&1
+  log "phase0 bench_precond rc=$?"
+fi
+
+# 1. flash attention hardware tests (KFAC_TEST_TPU=1 skips the CPU override)
+log "phase1 flash-hw start"
+KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware > /tmp/flash_hw.log 2>&1
+log "phase1 flash-hw rc=$?"
+
+# 2. CIFAR convergence: K-FAC then SGD, identical schedules, real chip
+log "phase2 cifar-kfac start"
+python examples/train_cifar10_resnet.py \
+  --model resnet32 --epochs 40 --lr-decay 25 35 \
+  --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+  --precond-precision default --eigen-dtype bf16 \
+  --log-dir logs/cifar10_resnet32_kfac --checkpoint-dir /tmp/cc_kfac \
+  > /tmp/cifar_kfac.log 2>&1
+log "phase2 cifar-kfac rc=$?"
+
+log "phase3 cifar-sgd start"
+python examples/train_cifar10_resnet.py \
+  --model resnet32 --epochs 40 --lr-decay 25 35 \
+  --kfac-update-freq 0 \
+  --log-dir logs/cifar10_resnet32_sgd --checkpoint-dir /tmp/cc_sgd \
+  > /tmp/cifar_sgd.log 2>&1
+log "phase3 cifar-sgd rc=$?"
+
+# 4. LM runs on the real code corpus
+log "phase4 wikitext start"
+python examples/train_wikitext_rnn.py \
+  --data-dir /tmp/code-corpus --epochs 6 --batch-size 20 --bptt 35 \
+  --emsize 256 --nhid 256 --kfac-update-freq 10 \
+  --log-dir logs/wikitext_lstm_kfac \
+  > /tmp/wikitext_kfac.log 2>&1
+log "phase4 wikitext rc=$?"
+
+log "phase5 transformer start"
+python examples/train_transformer_lm.py \
+  --data-dir /tmp/code-corpus --epochs 4 --batch-size 16 --seq-len 128 \
+  --d-model 256 --n-layers 2 --kfac-update-freq 10 \
+  --log-dir logs/transformer_lm_kfac \
+  > /tmp/transformer_kfac.log 2>&1
+log "phase5 transformer rc=$?"
+
+# 5.5 ImageNet augmented-pipeline throughput on the real chip (256px uint8
+# shards -> native RRC+normalize -> resnet50 steps)
+log "phase5.5 imagenet-pipe start"
+python examples/train_imagenet_resnet.py \
+  --data-dir /tmp/fake_imagenet256 --model resnet50 --epochs 1 \
+  --batch-size 32 --val-batch-size 32 --kfac-update-freq 10 \
+  --kfac-cov-update-freq 10 --checkpoint-dir "" \
+  --log-dir logs/imagenet_pipe_smoke \
+  > /tmp/imagenet_pipe.log 2>&1
+log "phase5.5 imagenet-pipe rc=$?"
+
+# 6. final bench
+log "phase6 bench start"
+python bench.py > /tmp/bench_final.json 2> /tmp/bench_final.log
+log "phase6 bench rc=$?"
+
+log "queue done"

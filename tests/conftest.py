@@ -1,9 +1,15 @@
-"""Test harness: force an 8-device virtual CPU mesh.
+"""Test harness: force an 8-device virtual CPU mesh (default).
 
 Multi-device collective/sharding paths (pmean/psum/shard_map) are exercised on
 fake CPU devices — real SPMD semantics, no TPU pod needed (SURVEY.md §4).
 See kfac_pytorch_tpu/platform_override.py for why env vars alone are too late
 on this image.
+
+``KFAC_TEST_TPU=1`` skips the CPU override so the TPU-gated tests (the
+``test_tpu_hardware_*`` Mosaic validations in test_flash_attention.py, which
+skip themselves off-TPU) can actually reach the chip:
+
+    KFAC_TEST_TPU=1 pytest tests/test_flash_attention.py -k tpu_hardware
 """
 
 import os
@@ -11,6 +17,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from kfac_pytorch_tpu.platform_override import force_cpu_devices
+if os.environ.get("KFAC_TEST_TPU") == "1":
+    from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
 
-assert force_cpu_devices(8), "JAX backend initialized before conftest ran"
+    enable_persistent_cache()
+else:
+    from kfac_pytorch_tpu.platform_override import force_cpu_devices
+
+    assert force_cpu_devices(8), "JAX backend initialized before conftest ran"

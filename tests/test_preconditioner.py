@@ -230,6 +230,34 @@ def test_sharded_eigen_distribute_layer_factors_matches():
                                    np.asarray(g_sh[n]["kernel"]), rtol=1e-4, atol=1e-5)
 
 
+def test_bf16_eigen_storage_close_to_f32():
+    """eigen_dtype=bf16 stores Q matrices half-size; the preconditioned
+    direction must stay within bf16 tolerance of the f32 path (eigenvalues
+    and the damped divide remain f32)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(9)
+    params = _dense_params(rng, [8, 6, 5])
+    a_c, g_s, grads = _stats_for(params, rng)
+    out = {}
+    for dt in (jnp.float32, jnp.bfloat16):
+        kfac = KFAC(damping=0.01, eigen_dtype=dt)
+        g, state = kfac.update(
+            grads, kfac.init(params), a_contribs=a_c, g_factor_stats=g_s,
+            lr=0.1, damping=0.01, update_factors=True, update_eigen=True,
+        )
+        assert state["eigen"]["l0"]["QA"].dtype == dt
+        assert state["eigen"]["l0"]["dA"].dtype == jnp.float32
+        out[dt] = np.concatenate(
+            [np.ravel(np.asarray(x, np.float32))
+             for x in jax.tree_util.tree_leaves(g)]
+        )
+    a, b = out[jnp.float32], out[jnp.bfloat16]
+    cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999, f"bf16 eigen storage diverges: cos={cos}"
+    np.testing.assert_allclose(a, b, rtol=0.1, atol=0.02)
+
+
 def test_round_robin_parity():
     rr = RoundRobin(3)
     assert rr.next(2) == (0, 1)
