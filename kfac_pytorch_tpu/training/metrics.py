@@ -34,10 +34,12 @@ class Metric:
 
 
 class ScalarWriter:
-    """TensorBoard ``SummaryWriter`` if importable, else JSONL scalars.
+    """JSONL scalar stream, plus TensorBoard events when importable.
 
     Rank-0-only, like the reference's writer (pytorch_cifar10_resnet.py:
-    108-113).
+    108-113). The JSONL stream (``scalars.jsonl``) is ALWAYS written — it is
+    the machine-readable artifact convergence curves are committed from;
+    TensorBoard is the interactive view on top when the package exists.
     """
 
     def __init__(self, log_dir: Optional[str], enabled: bool = True):
@@ -46,17 +48,18 @@ class ScalarWriter:
         if not (enabled and log_dir):
             return
         os.makedirs(log_dir, exist_ok=True)
+        self._fh = open(os.path.join(log_dir, "scalars.jsonl"), "a")
         try:
             from torch.utils.tensorboard import SummaryWriter  # type: ignore
 
             self._tb = SummaryWriter(log_dir)
         except Exception:
-            self._fh = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+            pass
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         if self._tb is not None:
             self._tb.add_scalar(tag, value, step)
-        elif self._fh is not None:
+        if self._fh is not None:
             self._fh.write(
                 json.dumps(
                     {"ts": time.time(), "tag": tag, "value": float(value), "step": step}
