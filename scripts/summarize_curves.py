@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Summarize committed training curves (scalars.jsonl) into a table.
+
+Usage:
+    python scripts/summarize_curves.py logs/cifar10_resnet32_kfac [logs/...]
+    python scripts/summarize_curves.py --compare logs/..._kfac logs/..._sgd
+
+With --compare, prints per-epoch val accuracy side by side and the fraction
+of epochs where the first run >= the second (the reference's headline claim
+is K-FAC >= SGD accuracy per epoch, README.md:57-60).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from collections import defaultdict
+
+
+def load(run_dir: str):
+    path = os.path.join(run_dir, "scalars.jsonl")
+    series = defaultdict(dict)
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            series[rec["tag"]][rec["step"]] = rec["value"]
+    return series
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("runs", nargs="+")
+    ap.add_argument("--compare", action="store_true")
+    ap.add_argument("--tag", default="val/accuracy")
+    args = ap.parse_args()
+
+    if not args.compare:
+        for run in args.runs:
+            series = load(run)
+            print(f"== {run}")
+            for tag in sorted(series):
+                steps = sorted(series[tag])
+                vals = [series[tag][s] for s in steps]
+                # lower-is-better tags: loss / perplexity
+                best = min(vals) if ("loss" in tag or "ppl" in tag) else max(vals)
+                print(
+                    f"  {tag}: {len(steps)} points, first {vals[0]:.4f}, "
+                    f"best {best:.4f}, last {vals[-1]:.4f}"
+                )
+        return
+
+    a, b = args.runs[0], args.runs[1]
+    sa, sb = load(a)[args.tag], load(b)[args.tag]
+    steps = sorted(set(sa) & set(sb))
+    wins = 0
+    print(f"epoch  {os.path.basename(a):>24}  {os.path.basename(b):>24}")
+    for s in steps:
+        mark = ">=" if sa[s] >= sb[s] else "< "
+        wins += sa[s] >= sb[s]
+        print(f"{s:5d}  {sa[s]:24.4f}  {mark} {sb[s]:22.4f}")
+    print(
+        f"\n{args.tag}: {os.path.basename(a)} >= {os.path.basename(b)} on "
+        f"{wins}/{len(steps)} epochs; best {max(sa.values()):.4f} vs "
+        f"{max(sb.values()):.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()
