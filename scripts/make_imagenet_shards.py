@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Convert an ImageFolder tree (train/<wnid>/*.JPEG) into numpy shards.
+
+The TPU trainers consume contiguous uint8 numpy shards
+(``{split}_x.npy``/``{split}_y.npy``, NHWC) instead of a JPEG tree — decode
+happens ONCE at staging time, and the training-time pipeline (native C++
+loader, runtime/native/loader.cpp) does only crop/resize/flip/normalize.
+This is the staging step the reference performs by untarring JPEGs to
+node-local disk (sbatch/cp_imagenet_to_temp.sh) plus torchvision's per-epoch
+re-decode, folded into one ahead-of-time pass.
+
+Images are resized so the SHORTER side equals ``--store-size`` (default 256,
+matching the eval Resize) and center-cropped square — train-time
+RandomResizedCrop then samples windows of that stored square. Class ids are
+the sorted directory-name order (torchvision ImageFolder convention).
+
+Usage:
+    python scripts/make_imagenet_shards.py --src /data/imagenet/train \
+        --out /tmp/imagenet-shards --split train [--store-size 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", required=True, help="ImageFolder split dir")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--split", required=True, choices=["train", "val"])
+    ap.add_argument("--store-size", type=int, default=256)
+    ap.add_argument("--limit", type=int, default=None, help="cap images (smoke)")
+    args = ap.parse_args()
+
+    from PIL import Image
+
+    classes = sorted(
+        d for d in os.listdir(args.src) if os.path.isdir(os.path.join(args.src, d))
+    )
+    if not classes:
+        raise SystemExit(f"no class directories under {args.src}")
+    files = []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(args.src, cls)
+        for f in sorted(os.listdir(cdir)):
+            files.append((os.path.join(cdir, f), label))
+    if args.limit:
+        files = files[: args.limit]
+
+    s = args.store_size
+    os.makedirs(args.out, exist_ok=True)
+    xp = os.path.join(args.out, f"{args.split}_x.npy")
+    yp = os.path.join(args.out, f"{args.split}_y.npy")
+    # memmap output: the train split is ~250 GB at 256px — never in RAM
+    x = np.lib.format.open_memmap(
+        xp, mode="w+", dtype=np.uint8, shape=(len(files), s, s, 3)
+    )
+    y = np.empty(len(files), np.int32)
+    for i, (path, label) in enumerate(files):
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            w, h = im.size
+            scale = s / min(w, h)
+            im = im.resize((round(w * scale), round(h * scale)), Image.BILINEAR)
+            left = (im.width - s) // 2
+            top = (im.height - s) // 2
+            im = im.crop((left, top, left + s, top + s))
+            x[i] = np.asarray(im, np.uint8)
+        y[i] = label
+        if i % 10000 == 0:
+            print(f"{i}/{len(files)}", flush=True)
+    x.flush()
+    np.save(yp, y)
+    print(f"wrote {len(files)} images -> {xp} ({len(classes)} classes)")
+
+
+if __name__ == "__main__":
+    main()
