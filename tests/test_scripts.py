@@ -1,0 +1,60 @@
+"""Staging/tooling scripts: shard builder and corpus builder contracts."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_make_imagenet_shards_roundtrip(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    r = np.random.RandomState(0)
+    for cls in ["n01", "n02", "n03"]:
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(
+                r.randint(0, 255, (50, 70, 3), dtype=np.uint8)
+            ).save(d / f"im{i}.JPEG")
+    out = tmp_path / "shards"
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "make_imagenet_shards.py"),
+         "--src", str(tmp_path / "train"), "--out", str(out),
+         "--split", "train", "--store-size", "32"],
+        check=True, capture_output=True,
+    )
+    x = np.load(out / "train_x.npy")
+    y = np.load(out / "train_y.npy")
+    assert x.shape == (6, 32, 32, 3) and x.dtype == np.uint8
+    # sorted-directory class ids, 2 images each
+    assert y.tolist() == [0, 0, 1, 1, 2, 2]
+
+
+def test_make_code_corpus(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.py").write_text("def f(x):\n    return x + 1\n" * 200)
+    out = tmp_path / "corpus"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "make_code_corpus.py"),
+         "--src", str(src), "--out", str(out), "--vocab-size", "50",
+         "--max-tokens", "5000"],
+        check=True, capture_output=True, text=True,
+    )
+    assert "corpus:" in res.stdout
+    for split in ("train", "valid", "test"):
+        assert (out / f"wiki.{split}.tokens").is_file()
+    # the trainers' corpus loader can consume it
+    sys.path.insert(0, REPO)
+    from kfac_pytorch_tpu.training import data as data_lib
+
+    splits, words = data_lib.build_corpus(str(out))
+    assert set(splits) == {"train", "valid", "test"}
+    assert 2 < len(words) <= 52
+    assert splits["train"].dtype == np.int32
