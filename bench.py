@@ -114,8 +114,12 @@ def _timeit(step, state, warmup=2, iters=20, windows=3, label=""):
 
 
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
-                 kfac_kwargs=None):
-    """Measure SGD + the three K-FAC step variants for one compute dtype."""
+                 kfac_kwargs=None, sgd_time=None):
+    """Measure SGD + the three K-FAC step variants for one compute dtype.
+
+    ``sgd_time``: optional ``(mean_s, std_s)`` from a prior arm with the same
+    model dtype — the SGD program is identical across K-FAC-config arms, so
+    re-measuring it would only add compile minutes over the TPU tunnel."""
     from kfac_pytorch_tpu import KFAC
     from kfac_pytorch_tpu.models import imagenet_resnet
     from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
@@ -160,9 +164,12 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
             return s
         return _step
 
-    t_sgd, sd_sgd, _ = _timeit(run_sgd, fresh_state(None), label=f"sgd{tag}")
-    print(f"sgd{tag} step: {t_sgd*1e3:.2f} ms ±{sd_sgd*1e3:.2f} "
-          f"({batch/t_sgd:.1f} img/s)", file=sys.stderr)
+    if sgd_time is None:
+        t_sgd, sd_sgd, _ = _timeit(run_sgd, fresh_state(None), label=f"sgd{tag}")
+        print(f"sgd{tag} step: {t_sgd*1e3:.2f} ms ±{sd_sgd*1e3:.2f} "
+              f"({batch/t_sgd:.1f} img/s)", file=sys.stderr)
+    else:
+        t_sgd, sd_sgd = sgd_time
 
     # populate eigen state once so the plain variant preconditions real factors
     _log(f"kfac{tag}: compiling full (factors+eigen) step ...")
@@ -216,26 +223,53 @@ def main():
     _log(f"device={devices[0]} batch={batch} image={size}")
 
     f32 = _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="")
+    sgd_f32 = (f32["sgd_ms"] / 1e3, f32["sgd_ms_std"] / 1e3)
     try:
         bf16 = _measure_arm(batch, size, fac_freq, kfac_freq,
                             dtype=jnp.bfloat16, tag="-bf16")
     except Exception as e:  # noqa: BLE001 — bf16 arm is informational
         _log(f"bf16 arm failed: {type(e).__name__}: {e}")
         bf16 = None
+    from jax import lax
+
     try:
         # aggressive K-FAC numerics: 1-pass-bf16 rotations + bf16-stored
         # eigenvectors (convergence-validated on the CIFAR curves,
         # docs/PERF.md); model compute stays f32
-        from jax import lax
-
         aggr = _measure_arm(
             batch, size, fac_freq, kfac_freq, dtype=None, tag="-aggr",
             kfac_kwargs=dict(precond_precision=lax.Precision.DEFAULT,
                              eigen_dtype=jnp.bfloat16),
+            sgd_time=sgd_f32,
         )
     except Exception as e:  # noqa: BLE001
         _log(f"aggressive arm failed: {type(e).__name__}: {e}")
         aggr = None
+    try:
+        # inverse method (KFAC(precond_method='inverse')) at the DEFAULT
+        # K-FAC numerics (HIGH-precision solve matmuls, f32 storage):
+        # 2 matmuls/layer per step instead of 4, half the curvature HBM
+        # stream, Cholesky refresh instead of eigh — isolates the method's
+        # effect; the combined best config is the '-inv-aggr' arm below
+        inv = _measure_arm(
+            batch, size, fac_freq, kfac_freq, dtype=None, tag="-inv",
+            kfac_kwargs=dict(precond_method="inverse"),
+            sgd_time=sgd_f32,
+        )
+    except Exception as e:  # noqa: BLE001
+        _log(f"inverse arm failed: {type(e).__name__}: {e}")
+        inv = None
+    try:
+        inv_aggr = _measure_arm(
+            batch, size, fac_freq, kfac_freq, dtype=None, tag="-inv-aggr",
+            kfac_kwargs=dict(precond_method="inverse",
+                             precond_precision=lax.Precision.DEFAULT,
+                             eigen_dtype=jnp.bfloat16),
+            sgd_time=sgd_f32,
+        )
+    except Exception as e:  # noqa: BLE001
+        _log(f"inverse-aggressive arm failed: {type(e).__name__}: {e}")
+        inv_aggr = None
 
     overhead_pct = f32["overhead_pct"]
     print(
@@ -252,6 +286,13 @@ def main():
                     "f32": f32,
                     "bf16": bf16,
                     "kfac_aggressive_numerics": aggr,
+                    "kfac_inverse_method": inv,
+                    "kfac_inverse_aggressive": inv_aggr,
+                    "best_overhead_pct": min(
+                        a["overhead_pct"]
+                        for a in (f32, aggr, inv, inv_aggr)
+                        if a is not None
+                    ),
                 },
             }
         )

@@ -93,10 +93,21 @@ def parse_args(argv=None):
     p.add_argument("--kl-clip", type=float, default=0.001)
     p.add_argument("--diag-blocks", type=int, default=1)
     p.add_argument("--diag-warmup", type=int, default=5)
+    p.add_argument("--distribute-precondition", action="store_true",
+                   help="shard the every-step eigenbasis rotations across "
+                        "the mesh (one owner device per layer + psum "
+                        "exchange); recommended at pod scale, see "
+                        "docs/PERF.md")
     p.add_argument("--distribute-layer-factors", type=lambda s: s.lower() == "true",
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--precond-method", default="eigen",
+                   choices=["eigen", "inverse"],
+                   help="eigen: reference-parity eigenbasis solve (damping "
+                        "fresh every step); inverse: pi-corrected factored "
+                        "Tikhonov damping + Cholesky inverses (2 matmuls/"
+                        "layer per step instead of 4; docs/PERF.md)")
     p.add_argument("--precond-precision", default=None,
                    choices=["default", "high", "highest"],
                    help="matmul precision of the every-step eigenbasis "
@@ -169,8 +180,10 @@ def main(argv=None):
             diag_blocks=args.diag_blocks,
             diag_warmup=args.diag_warmup,
             distribute_layer_factors=args.distribute_layer_factors,
+            distribute_precondition=args.distribute_precondition,
             mesh=mesh if world > 1 else None,
             precond_precision=args.precond_precision,
+            precond_method=args.precond_method,
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
         )
 

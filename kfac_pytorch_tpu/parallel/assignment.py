@@ -32,6 +32,32 @@ class RoundRobin:
         return tuple(next(self._it) for _ in range(size))
 
 
+def precondition_assignment(
+    shapes: Dict[str, Tuple[int, int]], world: int
+) -> Dict[str, int]:
+    """Assign each layer's every-step gradient-rotation job to one device.
+
+    Unlike the eigendecomp table (round-robin for reference parity,
+    kfac_preconditioner.py:383-396), the rotation jobs have precisely known
+    costs — 4·(g²·a + g·a²) FLOPs for a ``[g, a]`` gradient — and run EVERY
+    step, so balance matters more than cache affinity. Greedy
+    longest-processing-time: place each layer (heaviest first) on the least
+    loaded device. Deterministic: ties break on layer name, then device
+    index, so every host derives the same table.
+    """
+    jobs = sorted(
+        shapes.items(),
+        key=lambda kv: (-(kv[1][0] ** 2 * kv[1][1] + kv[1][0] * kv[1][1] ** 2), kv[0]),
+    )
+    load = [0] * world
+    owners: Dict[str, int] = {}
+    for name, (g, a) in jobs:
+        dev = min(range(world), key=lambda d: (load[d], d))
+        owners[name] = dev
+        load[dev] += g * g * a + g * a * a
+    return owners
+
+
 def layer_assignment(
     names: List[str],
     is_conv: Dict[str, bool],

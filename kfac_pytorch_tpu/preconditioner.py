@@ -40,7 +40,10 @@ from jax.sharding import Mesh
 from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
-from kfac_pytorch_tpu.parallel.assignment import layer_assignment
+from kfac_pytorch_tpu.parallel.assignment import (
+    layer_assignment,
+    precondition_assignment,
+)
 from kfac_pytorch_tpu.parallel.sharded_eigh import (
     replicated_eigen_update,
     sharded_eigen_update,
@@ -98,12 +101,14 @@ class KFAC:
         diag_blocks: int = 1,
         diag_warmup: int = 0,
         distribute_layer_factors: Optional[bool] = None,
+        distribute_precondition: bool = False,
         mesh: Optional[Mesh] = None,
         axis_name: str = "data",
         eps: float = 1e-10,
         layers: Optional[list] = None,
         precond_precision: Optional[Any] = None,
         eigen_dtype: Any = jnp.float32,
+        precond_method: str = "eigen",
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -131,6 +136,15 @@ class KFAC:
         self.diag_blocks = diag_blocks
         self.diag_warmup = diag_warmup
         self.distribute_layer_factors = distribute_layer_factors
+        # Shard the EVERY-STEP eigenbasis rotations across the mesh (each
+        # layer's triple-matmul chain runs on one owner device; one psum
+        # reassembles). The reference replicates this work on every rank
+        # (kfac_preconditioner.py:401-404) — fine when the per-rank SGD step
+        # is ~90 ms (V100), a ~100% fixed tax when it is ~1.6 ms (v5e,
+        # docs/PERF.md). Off by default: on 1-8 devices the psum can cost
+        # more than the saved matmuls; enable at pod scale (the v5e-64
+        # recipe), where per-device rotation work drops ~1/64.
+        self.distribute_precondition = distribute_precondition
         self.mesh = mesh
         self.axis_name = axis_name
         self.eps = eps
@@ -157,6 +171,23 @@ class KFAC:
         # damped divide) stay f32 regardless. Validated by the CIFAR
         # convergence runs (docs/PERF.md).
         self.eigen_dtype = eigen_dtype
+        # "eigen" (reference parity: exact (G⊗A+λI)⁻¹ in the eigenbasis,
+        # damping fresh every step, 4 rotations/layer) or "inverse"
+        # (π-corrected factored Tikhonov damping + explicit Cholesky
+        # inverses: 2 matmuls/layer per step, half the curvature HBM
+        # stream, ~30x cheaper refresh; damping takes effect at the next
+        # refresh). See ops/precondition.py's inverse-method comment.
+        _validate(
+            "precond_method", precond_method in ("eigen", "inverse"), precond_method
+        )
+        if precond_method == "inverse" and diag_blocks != 1:
+            raise ValueError(
+                "diag_blocks > 1 (and its diag_warmup schedule) is a feature "
+                "of the eigenbasis path; precond_method='inverse' inverts "
+                "whole factors and would silently ignore the configured "
+                "block-diagonal approximation"
+            )
+        self.precond_method = precond_method
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -218,15 +249,24 @@ class KFAC:
                 "A": jnp.eye(a_side, dtype=jnp.float32),
                 "G": jnp.eye(g_side, dtype=jnp.float32),
             }
-            eigen[name] = {
-                "QA": jnp.zeros((a_side, a_side), self.eigen_dtype),
-                "dA": jnp.zeros((a_side,), jnp.float32),
-                "QG": jnp.zeros((g_side, g_side), self.eigen_dtype),
-                "dG": jnp.zeros((g_side,), jnp.float32),
-            }
+            if self.precond_method == "inverse":
+                eigen[name] = {
+                    "iA": jnp.zeros((a_side, a_side), self.eigen_dtype),
+                    "iG": jnp.zeros((g_side, g_side), self.eigen_dtype),
+                }
+            else:
+                eigen[name] = {
+                    "QA": jnp.zeros((a_side, a_side), self.eigen_dtype),
+                    "dA": jnp.zeros((a_side,), jnp.float32),
+                    "QG": jnp.zeros((g_side, g_side), self.eigen_dtype),
+                    "dG": jnp.zeros((g_side,), jnp.float32),
+                }
         # same-shape groups live ONLY pre-stacked (batched-rotation form);
         # singleton shapes stay per-layer — see split_eigen_state
-        singles, stacked = precond_ops.split_eigen_state(eigen)
+        if self.precond_method == "inverse":
+            singles, stacked = precond_ops.split_inv_state(eigen)
+        else:
+            singles, stacked = precond_ops.split_eigen_state(eigen)
         return {
             "step": jnp.zeros((), jnp.int32),
             "factors": facs,
@@ -310,7 +350,23 @@ class KFAC:
 
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
-        if update_eigen:
+        if update_eigen and self.precond_method == "inverse":
+            # Curvature refresh, inverse method: π-damped Cholesky inverses.
+            # Computed replicated — a batched Cholesky solve is ~30x cheaper
+            # than the eigendecompositions (n³/3 vs ~10n³ per factor), so at
+            # kfac_update_freq amortization sharding it is not worth an
+            # exchange; the EVERY-STEP solve still shards via
+            # distribute_precondition.
+            inv = precond_ops.factored_inverse_all(
+                facs, jnp.asarray(damping, jnp.float32), self.eps
+            )
+            if self.eigen_dtype != jnp.float32:
+                inv = {
+                    n: {k: v.astype(self.eigen_dtype) for k, v in e.items()}
+                    for n, e in inv.items()
+                }
+            eigen, stacked = precond_ops.split_inv_state(inv)
+        elif update_eigen:
             # diag_warmup: use 1 block until `epoch >= diag_warmup`
             # (kfac_preconditioner.py:361-367), via the static flag.
             diag_blocks = self.diag_blocks if diag_warmup_done else 1
@@ -352,13 +408,31 @@ class KFAC:
             name: mat.astype(jnp.float32)
             for name, mat in capture.grad_mats(lgrads).items()
         }
-        if self.precond_precision is not None:
-            updates = precond_ops.precondition_all(
-                gmats, eigen, damping, self.precond_precision, stacked=stacked
+        precision_args = (
+            (self.precond_precision,) if self.precond_precision is not None else ()
+        )
+        inverse = self.precond_method == "inverse"
+        if self.distribute_precondition and self._world() > 1:
+            owners = precondition_assignment(
+                {name: tuple(g.shape) for name, g in gmats.items()},
+                self._world(),
+            )
+            dist_fn = (
+                precond_ops.precondition_all_inv_distributed
+                if inverse
+                else precond_ops.precondition_all_distributed
+            )
+            updates = dist_fn(
+                gmats, eigen, damping, *precision_args, stacked=stacked,
+                mesh=self.mesh, owners=owners,
+            )
+        elif inverse:
+            updates = precond_ops.precondition_all_inv(
+                gmats, eigen, *precision_args, stacked=stacked
             )
         else:
             updates = precond_ops.precondition_all(
-                gmats, eigen, damping, stacked=stacked
+                gmats, eigen, damping, *precision_args, stacked=stacked
             )
 
         # Global KL trust-region rescale (kfac_preconditioner.py:311-334).

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Generate a tiny fake ImageFolder tree (random JPEGs, varied sizes) so the
+ImageNet staging + augmented-pipeline path can be exercised end-to-end on a
+box with no ImageNet. Classes get distinct mean colors so a model can learn."""
+import argparse
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--per-class", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    rng = np.random.default_rng(args.seed)
+    for c in range(args.classes):
+        cdir = os.path.join(args.out, f"class{c:03d}")
+        os.makedirs(cdir, exist_ok=True)
+        mean = rng.integers(40, 216, size=3)
+        for i in range(args.per_class):
+            h = int(rng.integers(260, 420))
+            w = int(rng.integers(260, 420))
+            img = np.clip(
+                rng.normal(mean, 40, size=(h, w, 3)), 0, 255
+            ).astype(np.uint8)
+            Image.fromarray(img).save(os.path.join(cdir, f"im{i:04d}.jpg"))
+    print(f"wrote {args.classes}x{args.per_class} images under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

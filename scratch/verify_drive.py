@@ -1,0 +1,103 @@
+"""Verify drive: inverse-method + distributed preconditioning end-to-end.
+
+Drives the REAL training surface (training.step.make_train_step: capture ->
+factors -> EMA -> curvature -> precondition -> KL clip -> optax SGD step) on
+a toy regression MLP, per .claude/skills/verify/SKILL.md:
+
+1. K-FAC (eigen) and K-FAC (inverse) both train the loss down, at least as
+   fast per step as plain SGD (the reference's headline behavior).
+2. distribute_precondition=True on the 8-device CPU mesh reproduces the
+   replicated trajectory (both methods).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(KFACDense(32, name="d0")(x))
+        x = nn.relu(KFACDense(32, name="d1")(x))
+        return KFACDense(10, name="d2")(x)
+
+
+def make_data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 8).astype(np.float32)
+    w = rng.randn(8, 10).astype(np.float32)
+    y = np.argmax(x @ w + 0.3 * rng.randn(512, 10), axis=1).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def train(kfac, steps=40, lr=0.05, mesh=None):
+    x, y = make_data()
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    tx = make_sgd(momentum=0.9, weight_decay=0.0)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params) if kfac else None)
+    if mesh is not None:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        x = jax.device_put(x, NamedSharding(mesh, P("data")))
+        y = jax.device_put(y, NamedSharding(mesh, P("data")))
+    step_fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    losses = []
+    for i in range(steps):
+        kw = {}
+        if kfac is not None:
+            kw = dict(update_factors=i % 2 == 0, update_eigen=i % 10 == 0)
+        state, metrics = step_fn(
+            state, (x, y), jnp.float32(lr), jnp.float32(0.003), **kw)
+        losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+def main():
+    sgd_losses, _ = train(None)
+    print(f"sgd     : first={sgd_losses[0]:.4f} last={sgd_losses[-1]:.4f}")
+    final_params = {}
+    for method in ("eigen", "inverse"):
+        kfac = KFAC(damping=0.003, precond_method=method)
+        losses, st = train(kfac)
+        print(f"{method:8s}: first={losses[0]:.4f} last={losses[-1]:.4f}")
+        assert losses[-1] < 0.7 * losses[0], f"{method}: no convergence"
+        assert losses[-1] <= sgd_losses[-1] + 0.02, (
+            f"{method}: K-FAC ({losses[-1]:.4f}) should match/beat SGD "
+            f"({sgd_losses[-1]:.4f}) per step on this problem")
+        final_params[method] = st.params
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    for method in ("eigen", "inverse"):
+        kfac = KFAC(damping=0.003, precond_method=method, mesh=mesh,
+                    distribute_precondition=True)
+        losses_d, st_d = train(kfac, mesh=mesh)
+        for (pth, v1), (_, v2) in zip(
+            jax.tree_util.tree_leaves_with_path(final_params[method]),
+            jax.tree_util.tree_leaves_with_path(st_d.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(v1), np.asarray(v2), rtol=1e-3, atol=1e-5,
+                err_msg=f"{method} distributed!=replicated at {pth}")
+        print(f"{method:8s}: 40-step distributed trajectory == replicated ok")
+    print("VERIFY LIBRARY SURFACE: PASS")
+
+
+if __name__ == "__main__":
+    main()

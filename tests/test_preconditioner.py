@@ -326,3 +326,89 @@ def test_scheduler_resume_start_epoch():
                                start_epoch=15)
     sched.step(epoch=15)
     assert np.isclose(kfac.hparams.damping, 0.001)
+
+
+def _dense_params_with_repeats(rng):
+    """Layer set with repeated shapes (stacked eigen groups) + singletons."""
+    params = {}
+    for i, (nin, nout) in enumerate([(6, 5), (6, 5), (6, 5), (4, 3), (7, 2)]):
+        params[f"l{i}"] = {
+            "kernel": jnp.asarray(rng.randn(nin, nout).astype(np.float32)),
+            "bias": jnp.asarray(rng.randn(nout).astype(np.float32)),
+        }
+    return params
+
+
+def test_distributed_precondition_matches_replicated():
+    """distribute_precondition=True: per-layer rotations run on one owner
+    device each + psum exchange — results must equal the replicated path,
+    covering both stacked-group and singleton eigen layouts."""
+    rng = np.random.RandomState(7)
+    params = _dense_params_with_repeats(rng)
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, s_rep = kfac_rep.update(
+        grads, kfac_rep.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    assert s_rep["eigen_stacked"], "test model must exercise stacked groups"
+
+    mesh = data_parallel_mesh()
+    kfac_d = KFAC(damping=0.01, mesh=mesh, distribute_precondition=True)
+    state = kfac_d.init(params)
+    g_d, s_d = kfac_d.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    # and a stale-eigen (precondition-only) step — the every-step hot path
+    g_d2, _ = kfac_d.update(
+        grads, s_d, lr=0.1, damping=0.01,
+        update_factors=False, update_eigen=False)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_d[n]["kernel"]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_rep[n]["bias"]),
+                                   np.asarray(g_d[n]["bias"]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_d[n]["kernel"]),
+                                   np.asarray(g_d2[n]["kernel"]), atol=1e-6)
+
+
+def test_distributed_precondition_2d_mesh():
+    """Rotation owners are flat indices over ALL mesh axes (data×seq)."""
+    from jax.sharding import Mesh
+
+    rng = np.random.RandomState(8)
+    params = _dense_params_with_repeats(rng)
+    a_c, g_s, grads = _stats_for(params, rng)
+    devices = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "seq"))
+    kfac_d = KFAC(damping=0.01, mesh=mesh, distribute_precondition=True)
+    g_d, _ = kfac_d.update(
+        grads, kfac_d.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, _ = kfac_rep.update(
+        grads, kfac_rep.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_d[n]["kernel"]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_precondition_assignment_balanced_and_deterministic():
+    from kfac_pytorch_tpu.parallel.assignment import precondition_assignment
+
+    shapes = {f"l{i}": (64 * (1 + i % 4), 128) for i in range(12)}
+    owners = precondition_assignment(shapes, 4)
+    assert owners == precondition_assignment(dict(reversed(list(shapes.items()))), 4)
+    assert set(owners.values()) == {0, 1, 2, 3}  # every device gets work
+    cost = lambda s: s[0] ** 2 * s[1] + s[0] * s[1] ** 2
+    loads = [sum(cost(shapes[n]) for n, d in owners.items() if d == dev)
+             for dev in range(4)]
+    # greedy LPT keeps the makespan within 2x of the mean
+    assert max(loads) <= 2 * (sum(loads) / 4)
+    # more devices than layers: each layer still has exactly one owner in range
+    owners_big = precondition_assignment(shapes, 64)
+    assert all(0 <= d < 64 for d in owners_big.values())
