@@ -97,6 +97,11 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--precond-comm-dtype", default=None,
+                   choices=[None, "bf16"],
+                   help="downcast the distributed-precondition psum payload "
+                        "(the reference's --fp16-allreduce compression, "
+                        "applied to the preconditioned-grad exchange)")
     p.add_argument("--precond-method", default="eigen",
                    choices=["eigen", "inverse"],
                    help="eigen: reference-parity eigenbasis solve (damping "
@@ -167,6 +172,8 @@ def main(argv=None):
             mesh=mesh if world > 1 else None,
             precond_precision=args.precond_precision,
             precond_method=args.precond_method,
+            precond_comm_dtype=(jnp.bfloat16
+                                if args.precond_comm_dtype == "bf16" else None),
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
         )
         kfac_sched = KFACParamScheduler(

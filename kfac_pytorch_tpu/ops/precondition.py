@@ -10,7 +10,7 @@ schedule the reduction with everything else (no host sync).
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -88,20 +88,29 @@ def split_eigen_state(
     insertion order of :func:`shape_groups`, which the per-step grad
     stacking in :func:`precondition_all` re-derives identically.
     """
+    return _split_state(eigen, g_key="QG", a_key="QA")
+
+
+def _split_state(
+    state: Dict[str, Dict[str, jnp.ndarray]], g_key: str, a_key: str
+) -> Tuple[Dict[str, Dict[str, jnp.ndarray]], Dict[str, Dict[str, jnp.ndarray]]]:
+    """Shared singles/stacked split: one implementation of the state-layout
+    contract (shape derivation from the ``g_key``/``a_key`` matrices,
+    ``"{g}x{a}"`` stack keys, :func:`shape_groups` row order) for both the
+    eigen and inverse methods, so the layouts :func:`_stack_layout` assumes
+    are identical cannot drift apart."""
     shapes = {
-        n: (e["QG"].shape[0], e["QA"].shape[0]) for n, e in eigen.items()
+        n: (e[g_key].shape[0], e[a_key].shape[0]) for n, e in state.items()
     }
     singles: Dict[str, Dict[str, jnp.ndarray]] = {}
     stacked: Dict[str, Dict[str, jnp.ndarray]] = {}
     for (g, a), names in shape_groups(shapes).items():
         if len(names) < 2:
-            singles[names[0]] = eigen[names[0]]
+            singles[names[0]] = state[names[0]]
             continue
+        keys = state[names[0]].keys()
         stacked[f"{g}x{a}"] = {
-            "QA": jnp.stack([eigen[n]["QA"] for n in names]),
-            "QG": jnp.stack([eigen[n]["QG"] for n in names]),
-            "dA": jnp.stack([eigen[n]["dA"] for n in names]),
-            "dG": jnp.stack([eigen[n]["dG"] for n in names]),
+            k: jnp.stack([state[n][k] for n in names]) for k in keys
         }
     return singles, stacked
 
@@ -187,6 +196,7 @@ def _apply_distributed(
     mesh: Mesh,
     owners: Dict[str, int],
     solve_fn,
+    comm_dtype: Optional[Any] = None,
 ) -> Dict[str, jnp.ndarray]:
     """SPMD skeleton for owner-sharded per-layer preconditioning.
 
@@ -200,6 +210,13 @@ def _apply_distributed(
     HBM reads at run time. ``solve_fn(g, entry, damping)`` receives the
     layer's state entry (stacked groups row-sliced inside the owner branch
     only, so only owners pay the slice copy).
+
+    ``comm_dtype`` (e.g. ``jnp.bfloat16``) downcasts the exchanged updates
+    for the psum and casts back to f32 after — halving the wire bytes, the
+    TPU analog of the reference's Horovod fp16 allreduce compression
+    (``--fp16-allreduce``, pytorch_cifar10_resnet.py:190-195). Exact when a
+    slot has ONE owner (each element is a single device's value plus zeros,
+    so the sum itself adds no error beyond the downcast rounding).
     """
     axes = tuple(mesh.axis_names)
     where = _stack_layout({n: g.shape for n, g in grad_mats.items()}, stacked)
@@ -227,13 +244,17 @@ def _apply_distributed(
                     entry = {k: v[row] for k, v in stacks[key].items()}
                 return solve_fn(g, entry, damp)
 
+            dtype = comm_dtype or jnp.float32
             out[name] = lax.cond(
                 dev == owners[name],
-                _solve,
-                lambda g=g: jnp.zeros(g.shape, jnp.float32),
+                lambda _s=_solve, dtype=dtype: _s().astype(dtype),
+                lambda g=g, dtype=dtype: jnp.zeros(g.shape, dtype),
             )
         # Sum-of-zeros exchange: one allreduce over the whole update pytree.
-        return lax.psum(out, axes)
+        out = lax.psum(out, axes)
+        if comm_dtype is not None:
+            out = {n: v.astype(jnp.float32) for n, v in out.items()}
+        return out
 
     return _inner(grad_mats, singles, stacked or {}, damping)
 
@@ -247,6 +268,7 @@ def precondition_all_distributed(
     *,
     mesh: Mesh,
     owners: Dict[str, int],
+    comm_dtype: Optional[Any] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Eigenbasis preconditioning with rotations SHARDED across the mesh.
 
@@ -268,7 +290,7 @@ def precondition_all_distributed(
         )
 
     return _apply_distributed(
-        grad_mats, eigen, stacked, damping, mesh, owners, _solve
+        grad_mats, eigen, stacked, damping, mesh, owners, _solve, comm_dtype
     )
 
 
@@ -356,17 +378,7 @@ def split_inv_state(
 ) -> Tuple[Dict[str, Dict[str, jnp.ndarray]], Dict[str, Dict[str, jnp.ndarray]]]:
     """Inverse-method analog of :func:`split_eigen_state`: same-shape layers
     live only as stacked ``{'iA': [k,a,a], 'iG': [k,g,g]}`` groups."""
-    shapes = {n: (e["iG"].shape[0], e["iA"].shape[0]) for n, e in inv.items()}
-    singles: Dict[str, Dict[str, jnp.ndarray]] = {}
-    stacked: Dict[str, Dict[str, jnp.ndarray]] = {}
-    for (g, a), names in shape_groups(shapes).items():
-        if len(names) < 2:
-            singles[names[0]] = inv[names[0]]
-            continue
-        stacked[f"{g}x{a}"] = {
-            k: jnp.stack([inv[n][k] for n in names]) for k in ("iA", "iG")
-        }
-    return singles, stacked
+    return _split_state(inv, g_key="iG", a_key="iA")
 
 
 def precondition_mat_inv(
@@ -422,6 +434,7 @@ def precondition_all_inv_distributed(
     *,
     mesh: Mesh,
     owners: Dict[str, int],
+    comm_dtype: Optional[Any] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Owner-sharded inverse-method solve (see :func:`_apply_distributed`).
     ``damping`` is unused at solve time (it was folded into the inverses) but
@@ -431,7 +444,7 @@ def precondition_all_inv_distributed(
         return precondition_mat_inv(g, e["iA"], e["iG"], precision)
 
     return _apply_distributed(
-        grad_mats, inv, stacked, damping, mesh, owners, _solve
+        grad_mats, inv, stacked, damping, mesh, owners, _solve, comm_dtype
     )
 
 

@@ -84,18 +84,22 @@ def main():
         final_params[method] = st.params
 
     mesh = Mesh(np.asarray(jax.devices()), ("data",))
-    for method in ("eigen", "inverse"):
+    for method, comm in (("eigen", None), ("inverse", None),
+                         ("eigen", jnp.bfloat16)):
         kfac = KFAC(damping=0.003, precond_method=method, mesh=mesh,
-                    distribute_precondition=True)
+                    distribute_precondition=True, precond_comm_dtype=comm)
         losses_d, st_d = train(kfac, mesh=mesh)
+        tol = dict(rtol=1e-3, atol=1e-5) if comm is None else dict(
+            rtol=5e-2, atol=1e-3)  # bf16 wire rounding accumulates over steps
         for (pth, v1), (_, v2) in zip(
             jax.tree_util.tree_leaves_with_path(final_params[method]),
             jax.tree_util.tree_leaves_with_path(st_d.params),
         ):
             np.testing.assert_allclose(
-                np.asarray(v1), np.asarray(v2), rtol=1e-3, atol=1e-5,
-                err_msg=f"{method} distributed!=replicated at {pth}")
-        print(f"{method:8s}: 40-step distributed trajectory == replicated ok")
+                np.asarray(v1), np.asarray(v2), **tol,
+                err_msg=f"{method}/comm={comm} distributed!=replicated at {pth}")
+        tag = f"{method}+bf16comm" if comm is not None else method
+        print(f"{tag:14s}: 40-step distributed trajectory == replicated ok")
     print("VERIFY LIBRARY SURFACE: PASS")
 
 

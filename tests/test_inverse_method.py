@@ -184,3 +184,32 @@ def test_invalid_method_rejected():
 
     with pytest.raises(ValueError):
         KFAC(precond_method="cholesky")
+
+
+def test_distributed_bf16_comm_close_to_replicated():
+    """precond_comm_dtype=bf16 compresses the exchange; single-owner slots
+    make the psum exact up to the downcast rounding (~1e-2 relative)."""
+    rng = np.random.RandomState(4)
+    params = _dense_params(rng, [6, 5, 4])
+    a_c, g_s, grads = _stats_for(params, rng)
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, _ = kfac_rep.update(
+        grads, kfac_rep.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    mesh = data_parallel_mesh()
+    kfac_d = KFAC(damping=0.01, mesh=mesh, distribute_precondition=True,
+                  precond_comm_dtype=jnp.bfloat16)
+    g_d, _ = kfac_d.update(
+        grads, kfac_d.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+    for n in params:
+        a, b = np.asarray(g_rep[n]["kernel"]), np.asarray(g_d[n]["kernel"])
+        denom = max(float(np.abs(a).max()), 1e-8)
+        assert np.abs(a - b).max() / denom < 2e-2, f"{n}: bf16 comm too lossy"
+
+
+def test_comm_dtype_requires_distribute():
+    import pytest
+
+    with pytest.raises(ValueError):
+        KFAC(precond_comm_dtype=jnp.bfloat16)  # no distribute_precondition
