@@ -72,6 +72,9 @@ def parse_args(argv=None):
                    help="devices on the 'seq' mesh axis (1 = no sequence parallelism)")
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     # K-FAC (same surface as the CNN trainers)
+    p.add_argument("--kfac-embedding", action="store_true",
+                   help="precondition the token embedding too (diagonal-A "
+                        "K-FAC; beyond the reference's Linear/Conv2d set)")
     p.add_argument("--kfac-update-freq", type=int, default=10, help="0 disables K-FAC")
     p.add_argument("--kfac-cov-update-freq", type=int, default=1)
     p.add_argument("--stat-decay", type=float, default=0.95)
@@ -135,6 +138,7 @@ def main(argv=None):
     model = transformer_lm.get_model(
         vocab, max_len=args.seq_len, d_model=args.d_model,
         n_heads=args.n_heads, n_layers=args.n_layers, attention_fn=attn,
+        kfac_embedding=args.kfac_embedding,
     )
     init_toks = jnp.zeros((global_bs, args.seq_len), jnp.int32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_toks, train=True)

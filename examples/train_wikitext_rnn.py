@@ -58,6 +58,9 @@ def parse_args(argv=None):
     p.add_argument("--nlayers", type=int, default=2)
     p.add_argument("--dropout", type=float, default=0.5)
     p.add_argument("--tied", action="store_true")
+    p.add_argument("--kfac-embedding", action="store_true",
+                   help="precondition the token embedding too (diagonal-A "
+                        "K-FAC; beyond the reference's Linear/Conv2d set)")
     p.add_argument("--batch-size", type=int, default=20)
     p.add_argument("--bptt", type=int, default=35)
     p.add_argument("--epochs", type=int, default=40)
@@ -96,7 +99,7 @@ def main(argv=None):
 
     model = wikitext_rnn.get_model(
         args.model, ntokens, args.emsize, args.nhid, args.nlayers,
-        args.dropout, args.tied,
+        args.dropout, args.tied, kfac_embedding=args.kfac_embedding,
     )
     tokens0 = jnp.zeros((args.batch_size, args.bptt), jnp.int32)
     variables = model.init(

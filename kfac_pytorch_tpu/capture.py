@@ -40,6 +40,10 @@ def layer_names(params: PyTree) -> List[str]:
     the model belongs to a capture-aware KFACDense/KFACConv; models mixing in
     other kernel-bearing modules (e.g. grouped convs, plain nn.Dense) must
     use :func:`discover_layers` and pass the result to ``KFAC(layers=...)``.
+    DELIBERATELY excludes ``embedding`` params: a plain ``nn.Embed`` is
+    common and non-capturing, so KFACEmbed layers are picked up only by
+    :func:`discover_layers` (which sees the sown contribution) or an
+    explicit ``layers=`` list — every example trainer uses the former.
     Order is the sorted flattened-path order — deterministic across
     processes, as the layer→device assignment requires.
     """
@@ -87,6 +91,9 @@ def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndar
     out = {}
     for name in names:
         node = _get_path(grads, name)
+        if "embedding" in node:
+            out[name] = {"embedding": node["embedding"]}
+            continue
         entry = {"kernel": node["kernel"]}
         if "bias" in node:
             entry["bias"] = node["bias"]
@@ -153,6 +160,10 @@ def write_back(
     grads = _deep_copy(grads)
     for name, mat in updates.items():
         node = _get_path(grads, name)
+        if "embedding" in node:
+            # [features, vocab] mat back to the [vocab, features] table
+            node["embedding"] = (mat * nu).T.astype(node["embedding"].dtype)
+            continue
         kernel_shape = node["kernel"].shape
         new = factors.mat_to_grads(
             mat * nu, kernel_shape, has_bias="bias" in node

@@ -5,6 +5,6 @@ examples/imagenet_resnet.py, examples/wikitext_models.py), built TPU-first on
 NHWC layouts and the capture-aware layers in ``layers.py``.
 """
 
-from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense
+from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense, KFACEmbed
 
-__all__ = ["KFACConv", "KFACDense"]
+__all__ = ["KFACConv", "KFACDense", "KFACEmbed"]

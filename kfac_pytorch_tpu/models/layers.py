@@ -125,6 +125,41 @@ class KFACDense(_KFACLayer):
         return self._maybe_perturb(y)
 
 
+class KFACEmbed(_KFACLayer):
+    """Embedding lookup (``y = table[ids]``) with K-FAC capture.
+
+    Drop-in for ``flax.linen.Embed``. BEYOND-reference capability: the
+    reference preconditions only Linear/Conv2d, leaving LM embeddings to
+    plain SGD (``known_modules``, kfac_preconditioner.py:103). A lookup is a
+    dense layer over one-hot inputs, whose input covariance is exactly the
+    diagonal of token frequencies — the A factor is a [vocab] vector
+    (ops/factors.py::compute_a_embed) and its eigenbasis is the identity, so
+    embedding K-FAC costs one [features, features] G factor plus elementwise
+    work on the vocab axis.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    embedding_init: Callable = nn.initializers.variance_scaling(
+        1.0, "fan_in", "normal", out_axis=0
+    )
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        table = self.param(
+            "embedding",
+            self.embedding_init,
+            (self.num_embeddings, self.features),
+            self.param_dtype,
+        )
+        self._sow_a(lambda: factors.compute_a_embed(ids, self.num_embeddings))
+        (table,) = nn.dtypes.promote_dtype(table, dtype=self.dtype)
+        y = jnp.take(table, ids, axis=0)
+        return self._maybe_perturb(y)
+
+
 class KFACConv(_KFACLayer):
     """2-D convolution (NHWC/HWIO) with K-FAC capture.
 

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.models.layers import KFACDense, KFACEmbed
 from kfac_pytorch_tpu.parallel.context import full_attention
 
 AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v, causal=...) -> out
@@ -73,6 +73,11 @@ class TransformerLM(nn.Module):
     d_ff: Optional[int] = None
     attention_fn: AttentionFn = full_attention
     dropout: float = 0.0
+    # Precondition the TOKEN embedding (KFACEmbed, diagonal-A K-FAC; beyond
+    # the reference's known_modules). Position embeddings stay SGD-trained —
+    # they act as per-position biases and their "input distribution" is a
+    # constant arange.
+    kfac_embedding: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -84,7 +89,8 @@ class TransformerLM(nn.Module):
                 f"sequence length {t} exceeds max_len {self.max_len} "
                 "(out-of-range position embeddings would be silently NaN)"
             )
-        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(tokens)
+        embed_cls = KFACEmbed if self.kfac_embedding else nn.Embed
+        x = embed_cls(self.vocab_size, self.d_model, name="tok_embed")(tokens)
         pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
             jnp.arange(t)[None, :]
         )
@@ -110,10 +116,12 @@ def get_model(
     n_layers: int = 2,
     attention_fn: AttentionFn = full_attention,
     dropout: float = 0.0,
+    kfac_embedding: bool = False,
 ) -> TransformerLM:
     """Factory in the style of the other zoos (models/__init__.py)."""
     return TransformerLM(
         vocab_size=vocab_size, max_len=max_len, d_model=d_model,
         n_heads=n_heads, n_layers=n_layers, attention_fn=attention_fn,
         dropout=dropout,
+        kfac_embedding=kfac_embedding,
     )

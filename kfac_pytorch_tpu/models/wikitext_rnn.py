@@ -29,7 +29,7 @@ from typing import Any, List, Optional, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.models.layers import KFACDense, KFACEmbed
 
 RNN_TYPES = ("LSTM", "GRU", "RNN_TANH", "RNN_RELU")
 
@@ -56,6 +56,11 @@ class RNNModel(nn.Module):
     rnn_type: str = "LSTM"
     dropout: float = 0.5
     tie_weights: bool = False
+    # Precondition the token embedding too (KFACEmbed, diagonal-A K-FAC) —
+    # beyond the reference, whose known_modules leaves embeddings to SGD.
+    # Incompatible with tie_weights (a tied decoder reads the table through
+    # Embed.attend; the lookup-side G factor does not describe that use).
+    kfac_embedding: bool = False
 
     @nn.compact
     def __call__(
@@ -66,7 +71,12 @@ class RNNModel(nn.Module):
     ) -> Tuple[jnp.ndarray, List[Any]]:
         if self.tie_weights and self.nhid != self.ninp:
             raise ValueError("tie_weights requires nhid == ninp")
-        encoder = nn.Embed(self.ntoken, self.ninp, name="encoder")
+        if self.tie_weights and self.kfac_embedding:
+            raise ValueError("kfac_embedding is incompatible with tie_weights")
+        if self.kfac_embedding:
+            encoder = KFACEmbed(self.ntoken, self.ninp, name="encoder")
+        else:
+            encoder = nn.Embed(self.ntoken, self.ninp, name="encoder")
         x = encoder(tokens)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
@@ -89,10 +99,11 @@ class RNNModel(nn.Module):
 
 def get_model(
     rnn_type: str, ntoken: int, ninp: int, nhid: int, nlayers: int,
-    dropout: float = 0.5, tied: bool = False,
+    dropout: float = 0.5, tied: bool = False, kfac_embedding: bool = False,
 ) -> RNNModel:
     """Factory mirroring the reference's ``RNNModel(...)`` signature."""
     return RNNModel(
         ntoken=ntoken, ninp=ninp, nhid=nhid, nlayers=nlayers,
         rnn_type=rnn_type, dropout=dropout, tie_weights=tied,
+        kfac_embedding=kfac_embedding,
     )

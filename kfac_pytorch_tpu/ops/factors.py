@@ -116,6 +116,22 @@ def compute_a_conv(
     return jnp.matmul(p.T, p / batch_size, precision=_HIGHEST)
 
 
+def compute_a_embed(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """Input-covariance DIAGONAL for an embedding layer: token frequencies.
+
+    An embedding lookup is a dense layer over one-hot rows, and the covariance
+    of one-hot rows is exactly diagonal: ``A = E[xxᵀ] = diag(counts / N)``
+    (row ``n`` contributes ``e_{id_n} e_{id_n}ᵀ``). Storing the [vocab]
+    diagonal instead of the [vocab, vocab] dense factor is what makes K-FAC
+    on embeddings tractable (vocab² would be ~10⁹ entries at 32k tokens) —
+    and it is EXACT, not an approximation. Beyond-reference capability: the
+    reference preconditions only Linear/Conv2d (kfac_preconditioner.py:103).
+    """
+    n = ids.size
+    counts = jnp.zeros((vocab,), jnp.float32).at[ids.reshape(-1)].add(1.0)
+    return counts / n
+
+
 def compute_g_dense(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
     """Grad-output covariance for a dense layer.
 
@@ -198,6 +214,10 @@ def grads_to_mat(layer_grads: Dict[str, Any]) -> jnp.ndarray:
     Conv kernels are flattened channel-major; a bias grad becomes the final
     column (homogeneous coordinate). Parity: kfac_preconditioner.py:270-286.
     """
+    if "embedding" in layer_grads:
+        # [vocab, features] table → [features, vocab] ("out" = features,
+        # "in" = the one-hot vocab axis); embeddings have no bias.
+        return layer_grads["embedding"].T
     kernel = layer_grads["kernel"]
     if kernel.ndim == 4:
         mat = conv_kernel_to_mat(kernel)

@@ -98,21 +98,58 @@ def _split_state(
     contract (shape derivation from the ``g_key``/``a_key`` matrices,
     ``"{g}x{a}"`` stack keys, :func:`shape_groups` row order) for both the
     eigen and inverse methods, so the layouts :func:`_stack_layout` assumes
-    are identical cannot drift apart."""
-    shapes = {
-        n: (e[g_key].shape[0], e[a_key].shape[0]) for n, e in state.items()
-    }
+    are identical cannot drift apart. Diagonal-A entries (embeddings — no
+    ``a_key`` matrix) always stay singles; :func:`diag_a_names` identifies
+    them for the grad-side grouping so both sides exclude the same set."""
     singles: Dict[str, Dict[str, jnp.ndarray]] = {}
+    square = {}
+    for n, e in state.items():
+        if a_key not in e:
+            singles[n] = e
+        else:
+            square[n] = e
+    shapes = {
+        n: (e[g_key].shape[0], e[a_key].shape[0]) for n, e in square.items()
+    }
     stacked: Dict[str, Dict[str, jnp.ndarray]] = {}
     for (g, a), names in shape_groups(shapes).items():
         if len(names) < 2:
-            singles[names[0]] = state[names[0]]
+            singles[names[0]] = square[names[0]]
             continue
-        keys = state[names[0]].keys()
+        keys = square[names[0]].keys()
         stacked[f"{g}x{a}"] = {
-            k: jnp.stack([state[n][k] for n in names]) for k in keys
+            k: jnp.stack([square[n][k] for n in names]) for k in keys
         }
     return singles, stacked
+
+
+def diag_a_names(eigen: Dict[str, Dict[str, jnp.ndarray]]) -> set:
+    """Layers whose A factor is a stored diagonal (embeddings): their state
+    entry carries eigenvalues/inverses for the A side but no A-side matrix."""
+    return {
+        n
+        for n, e in eigen.items()
+        if ("QA" not in e and "iA" not in e) and ("dA" in e or "iA_diag" in e)
+    }
+
+
+def precondition_mat_embed(
+    grad_mat: jnp.ndarray,
+    q_g: jnp.ndarray,
+    d_g: jnp.ndarray,
+    d_a: jnp.ndarray,
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Eigenbasis solve for a diagonal-A (embedding) layer.
+
+    A diagonal factor's eigenvectors are the identity, so the A-side
+    rotations vanish: ``v = QG · [(QGᵀ·g) / (dG dAᵀ + λ)]`` — exact
+    ``(G ⊗ A + λI)⁻¹`` on ``[features, vocab]`` gradients at the cost of two
+    G-side matmuls plus elementwise work on the vocab axis."""
+    v1 = jnp.matmul(q_g.T, grad_mat, precision=precision)
+    v2 = v1 / (d_g[:, None] * d_a[None, :] + damping)
+    return jnp.matmul(q_g, v2, precision=precision)
 
 
 def precondition_all(
@@ -135,8 +172,16 @@ def precondition_all(
     group eigen tensors pre-stacked; a group absent from ``stacked`` is
     stacked on the fly from per-layer entries (legacy full-format states).
     """
-    shapes = {name: g.shape for name, g in grad_mats.items()}
+    diag_a = diag_a_names(eigen)
     out: Dict[str, jnp.ndarray] = {}
+    for name in diag_a:
+        e = eigen[name]
+        out[name] = precondition_mat_embed(
+            grad_mats[name], e["QG"], e["dG"], e["dA"], damping, precision
+        )
+    shapes = {
+        name: g.shape for name, g in grad_mats.items() if name not in diag_a
+    }
     for (go, ai), names in shape_groups(shapes).items():
         if len(names) == 1:
             name = names[0]
@@ -169,14 +214,18 @@ def precondition_all(
 def _stack_layout(
     shapes: Dict[str, Tuple[int, int]],
     stacked: Optional[Dict[str, Dict[str, jnp.ndarray]]],
+    diag_a: set = frozenset(),
 ) -> Dict[str, Optional[Tuple[str, int]]]:
     """``name -> None (per-layer entry) | (stack_key, row)``.
 
     Shared by the distributed paths; derives the same grouping and row order
     as :func:`split_eigen_state`/:func:`precondition_all` (shape_groups is
-    the single source of truth).
-    """
-    where: Dict[str, Optional[Tuple[str, int]]] = {}
+    the single source of truth). ``diag_a`` layers (embeddings) are excluded
+    from grouping exactly as :func:`_split_state` excludes them — a
+    diagonal-A layer whose grad shape coincides with a dense stack must not
+    shift that stack's row indices."""
+    where: Dict[str, Optional[Tuple[str, int]]] = {n: None for n in diag_a}
+    shapes = {n: s for n, s in shapes.items() if n not in diag_a}
     for (go, ai), names in shape_groups(shapes).items():
         key = f"{go}x{ai}"
         if len(names) == 1 or stacked is None or key not in stacked:
@@ -219,7 +268,11 @@ def _apply_distributed(
     so the sum itself adds no error beyond the downcast rounding).
     """
     axes = tuple(mesh.axis_names)
-    where = _stack_layout({n: g.shape for n, g in grad_mats.items()}, stacked)
+    where = _stack_layout(
+        {n: g.shape for n, g in grad_mats.items()},
+        stacked,
+        diag_a_names(singles),
+    )
 
     @partial(
         jax.shard_map,
@@ -285,6 +338,10 @@ def precondition_all_distributed(
     """
 
     def _solve(g, e, damp):
+        if "QA" not in e:  # diagonal-A (embedding) layer
+            return precondition_mat_embed(
+                g, e["QG"], e["dG"], e["dA"], damp, precision
+            )
         return precondition_mat(
             g, e["QA"], e["QG"], e["dA"], e["dG"], damp, precision
         )
@@ -349,16 +406,28 @@ def factored_inverse_all(
     sqrt_l = jnp.sqrt(damping.astype(jnp.float32))
     pis = {}
     for n in names:
-        a_f, g_f = factors[n]["A"], factors[n]["G"]
-        tr_a = jnp.maximum(jnp.trace(a_f) / a_f.shape[0], eps)
+        f = factors[n]
+        # trace(A)/dim: for a stored-diagonal A (embedding) that's just the
+        # mean of the diagonal vector
+        if "A_diag" in f:
+            tr_a = jnp.maximum(jnp.mean(f["A_diag"]), eps)
+        else:
+            tr_a = jnp.maximum(jnp.trace(f["A"]) / f["A"].shape[0], eps)
+        g_f = f["G"]
         tr_g = jnp.maximum(jnp.trace(g_f) / g_f.shape[0], eps)
         pis[n] = jnp.sqrt(tr_a / tr_g)
 
     jobs: Dict[int, list] = {}
-    for n in names:
-        jobs.setdefault(factors[n]["A"].shape[0], []).append((n, "A"))
-        jobs.setdefault(factors[n]["G"].shape[0], []).append((n, "G"))
     out: Dict[str, Dict[str, jnp.ndarray]] = {n: {} for n in names}
+    for n in names:
+        if "A_diag" in factors[n]:
+            # diagonal A inverts elementwise; only G needs the Cholesky batch
+            out[n]["iA_diag"] = 1.0 / (
+                factors[n]["A_diag"].astype(jnp.float32) + pis[n] * sqrt_l
+            )
+        else:
+            jobs.setdefault(factors[n]["A"].shape[0], []).append((n, "A"))
+        jobs.setdefault(factors[n]["G"].shape[0], []).append((n, "G"))
     for side, batch in sorted(jobs.items()):
         stack = jnp.stack(
             [factors[n][f].astype(jnp.float32) for n, f in batch]
@@ -393,6 +462,17 @@ def precondition_mat_inv(
     )
 
 
+def precondition_mat_inv_embed(
+    grad_mat: jnp.ndarray,
+    i_a_diag: jnp.ndarray,
+    i_g: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Inverse-method solve for a diagonal-A (embedding) layer:
+    ``v = (iG · grad) ⊙ iA_diag``."""
+    return jnp.matmul(i_g, grad_mat, precision=precision) * i_a_diag[None, :]
+
+
 def precondition_all_inv(
     grad_mats: Dict[str, jnp.ndarray],
     inv: Dict[str, Dict[str, jnp.ndarray]],
@@ -401,8 +481,16 @@ def precondition_all_inv(
 ) -> Dict[str, jnp.ndarray]:
     """Inverse-method twin of :func:`precondition_all` (same-shape batching,
     same stack layout contract)."""
-    shapes = {name: g.shape for name, g in grad_mats.items()}
+    diag_a = diag_a_names(inv)
     out: Dict[str, jnp.ndarray] = {}
+    for name in diag_a:
+        e = inv[name]
+        out[name] = precondition_mat_inv_embed(
+            grad_mats[name], e["iA_diag"], e["iG"], precision
+        )
+    shapes = {
+        name: g.shape for name, g in grad_mats.items() if name not in diag_a
+    }
     for (go, ai), names in shape_groups(shapes).items():
         if len(names) == 1:
             name = names[0]
@@ -441,6 +529,8 @@ def precondition_all_inv_distributed(
     kept in the signature so both methods share the distributed skeleton."""
 
     def _solve(g, e, _damp):
+        if "iA_diag" in e:  # diagonal-A (embedding) layer
+            return precondition_mat_inv_embed(g, e["iA_diag"], e["iG"], precision)
         return precondition_mat_inv(g, e["iA"], e["iG"], precision)
 
     return _apply_distributed(

@@ -33,28 +33,38 @@ class RoundRobin:
 
 
 def precondition_assignment(
-    shapes: Dict[str, Tuple[int, int]], world: int
+    shapes: Dict[str, Tuple[int, int]],
+    world: int,
+    diag_a: Optional[set] = None,
 ) -> Dict[str, int]:
     """Assign each layer's every-step gradient-rotation job to one device.
 
     Unlike the eigendecomp table (round-robin for reference parity,
     kfac_preconditioner.py:383-396), the rotation jobs have precisely known
-    costs — 4·(g²·a + g·a²) FLOPs for a ``[g, a]`` gradient — and run EVERY
-    step, so balance matters more than cache affinity. Greedy
-    longest-processing-time: place each layer (heaviest first) on the least
-    loaded device. Deterministic: ties break on layer name, then device
-    index, so every host derives the same table.
+    costs and run EVERY step, so balance matters more than cache affinity:
+    ``g²·a + g·a²`` (MACs, up to the shared ×4/×2 method constant) for a
+    ``[g, a]`` dense gradient, but only ``g²·a`` for ``diag_a`` (embedding)
+    layers — their A side is elementwise, and costing the vocab axis
+    quadratically would dedicate a whole device to a nearly idle embedding.
+    Greedy longest-processing-time: place each layer (heaviest first) on the
+    least loaded device. Deterministic: ties break on layer name, then
+    device index, so every host derives the same table.
     """
+    diag_a = diag_a or set()
+
+    def cost(name, g, a):
+        return g * g * a if name in diag_a else g * g * a + g * a * a
+
     jobs = sorted(
         shapes.items(),
-        key=lambda kv: (-(kv[1][0] ** 2 * kv[1][1] + kv[1][0] * kv[1][1] ** 2), kv[0]),
+        key=lambda kv: (-cost(kv[0], kv[1][0], kv[1][1]), kv[0]),
     )
     load = [0] * world
     owners: Dict[str, int] = {}
     for name, (g, a) in jobs:
         dev = min(range(world), key=lambda d: (load[d], d))
         owners[name] = dev
-        load[dev] += g * g * a + g * a * a
+        load[dev] += cost(name, g, a)
     return owners
 
 

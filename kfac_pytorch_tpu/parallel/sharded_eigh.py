@@ -76,6 +76,8 @@ def build_slots(
     slots: List[EighSlot] = []
     for name in factors:
         for fac in ("A", "G"):
+            if fac not in factors[name]:
+                continue  # diagonal-A (embedding) layers have no A matrix
             n = factors[name][fac].shape[0]
             if assignment is not None:
                 owners = assignment[name][fac]
@@ -120,13 +122,15 @@ def _assemble(
     """Scatter per-slot (Q, d) into per-layer block-diagonal eigen buffers."""
     eigen: Dict[str, Dict[str, jnp.ndarray]] = {}
     for name, f in factors.items():
-        na, ng = f["A"].shape[0], f["G"].shape[0]
-        eigen[name] = {
-            "QA": jnp.zeros((na, na), jnp.float32),
-            "dA": jnp.zeros((na,), jnp.float32),
-            "QG": jnp.zeros((ng, ng), jnp.float32),
-            "dG": jnp.zeros((ng,), jnp.float32),
-        }
+        eigen[name] = {}
+        if "A" in f:
+            na = f["A"].shape[0]
+            eigen[name]["QA"] = jnp.zeros((na, na), jnp.float32)
+            eigen[name]["dA"] = jnp.zeros((na,), jnp.float32)
+        if "G" in f:
+            ng = f["G"].shape[0]
+            eigen[name]["QG"] = jnp.zeros((ng, ng), jnp.float32)
+            eigen[name]["dG"] = jnp.zeros((ng,), jnp.float32)
     for i, s in enumerate(slots):
         q, d = results[i]
         qk, dk = ("QA", "dA") if s.factor == "A" else ("QG", "dG")

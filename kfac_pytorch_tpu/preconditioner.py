@@ -220,7 +220,8 @@ class KFAC:
             node = params
             for k in name.split("/"):
                 node = node[k]
-            is_conv[name] = node["kernel"].ndim == 4
+            # embedding layers (no "kernel" param) are neither conv nor dense
+            is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
         return names, is_conv
 
     def _world(self) -> int:
@@ -249,6 +250,29 @@ class KFAC:
             node = params
             for k in name.split("/"):
                 node = node[k]
+            if "embedding" in node:
+                # Diagonal-A (embedding) layer: A is a [vocab] vector whose
+                # identity-init analog is all-ones (diag(I)); G is the usual
+                # [features, features] matrix. Beyond-reference capability
+                # (the reference's known_modules is {'Linear','Conv2d'},
+                # kfac_preconditioner.py:103).
+                vocab, feats = node["embedding"].shape
+                facs[name] = {
+                    "A_diag": jnp.ones((vocab,), jnp.float32),
+                    "G": jnp.eye(feats, dtype=jnp.float32),
+                }
+                if self.precond_method == "inverse":
+                    eigen[name] = {
+                        "iA_diag": jnp.zeros((vocab,), jnp.float32),
+                        "iG": jnp.zeros((feats, feats), self.eigen_dtype),
+                    }
+                else:
+                    eigen[name] = {
+                        "dA": jnp.zeros((vocab,), jnp.float32),
+                        "QG": jnp.zeros((feats, feats), self.eigen_dtype),
+                        "dG": jnp.zeros((feats,), jnp.float32),
+                    }
+                continue
             kernel = node["kernel"]
             has_bias = "bias" in node
             if kernel.ndim == 4:
@@ -334,7 +358,7 @@ class KFAC:
             node = grads
             for k in name.split("/"):
                 node = node[k]
-            is_conv[name] = node["kernel"].ndim == 4
+            is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
 
         facs = state["factors"]
         if update_factors:
@@ -350,11 +374,16 @@ class KFAC:
                     "capture-aware — construct KFAC(layers=capture."
                     "discover_layers(model, ...)) so init() matches capture."
                 )
+            # EMA runs elementwise, so the same update serves dense A
+            # matrices and embedding A_diag vectors (identity init = ones).
             facs = {
                 name: {
-                    "A": factor_ops.update_running_avg(
-                        a_contribs[name], facs[name]["A"], self.factor_decay
-                    ),
+                    ("A_diag" if "A_diag" in facs[name] else "A"):
+                        factor_ops.update_running_avg(
+                            a_contribs[name],
+                            facs[name].get("A", facs[name].get("A_diag")),
+                            self.factor_decay,
+                        ),
                     "G": factor_ops.update_running_avg(
                         g_factor_stats[name], facs[name]["G"], self.factor_decay
                     ),
@@ -376,7 +405,14 @@ class KFAC:
             )
             if self.eigen_dtype != jnp.float32:
                 inv = {
-                    n: {k: v.astype(self.eigen_dtype) for k, v in e.items()}
+                    # only the MATRIX inverses downcast; the embedding
+                    # iA_diag vector stays f32 like the eigen path's dA
+                    # (a dtype flip after the first refresh would retrace
+                    # the jitted step and break donated-buffer reuse)
+                    n: {
+                        k: (v if k == "iA_diag" else v.astype(self.eigen_dtype))
+                        for k, v in e.items()
+                    }
                     for n, e in inv.items()
                 }
             eigen, stacked = precond_ops.split_inv_state(inv)
@@ -401,15 +437,20 @@ class KFAC:
                     name: (diag_blocks if is_conv[name] else 1) for name in names
                 }
                 eigen = replicated_eigen_update(facs, blocks, self.eps)
+            # Diagonal-A (embedding) layers: the A "eigendecomposition" is
+            # the diagonal itself (eigenvectors = identity) — no eigh, just
+            # the reference's eigenvalue floor (kfac_preconditioner.py:253).
+            for n in names:
+                if "A_diag" in facs[n]:
+                    d = facs[n]["A_diag"]
+                    eigen[n]["dA"] = d * (d > self.eps)
             if self.eigen_dtype != jnp.float32:
                 # eigh itself always runs f32; only the stored/streamed Q
                 # matrices downcast (eigenvalues stay f32 for the divide)
                 eigen = {
                     n: {
-                        "QA": e["QA"].astype(self.eigen_dtype),
-                        "QG": e["QG"].astype(self.eigen_dtype),
-                        "dA": e["dA"],
-                        "dG": e["dG"],
+                        k: (v.astype(self.eigen_dtype) if k.startswith("Q") else v)
+                        for k, v in e.items()
                     }
                     for n, e in eigen.items()
                 }
@@ -430,6 +471,7 @@ class KFAC:
             owners = precondition_assignment(
                 {name: tuple(g.shape) for name, g in gmats.items()},
                 self._world(),
+                diag_a={n for n, f in facs.items() if "A_diag" in f},
             )
             dist_fn = (
                 precond_ops.precondition_all_inv_distributed

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Wait for tpu_queue.sh (v1) to finish, seed v2's DONE markers from v1's
+# successes, then run the v2 recovery queue.
+set -u
+while pgrep -f "bash scratch/tpu_queue.sh" > /dev/null; do sleep 60; done
+V1=/tmp/tpu_queue.status
+V2=/tmp/tpu_queue_v2.status
+touch "$V2"
+declare -A MAP=(
+  [phase0]=bench_precond [phase1]=flash-hw [phase2]=cifar-kfac
+  [phase3]=cifar-sgd [phase4]=wikitext [phase5]=transformer
+  [phase5.5]=imagenet-pipe [phase6]=bench
+)
+for p in "${!MAP[@]}"; do
+  if grep -q "$p .* rc=0" "$V1" 2>/dev/null; then
+    echo "DONE ${MAP[$p]}" >> "$V2"
+  fi
+done
+exec bash scratch/tpu_queue_v2.sh

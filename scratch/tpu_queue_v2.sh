@@ -1,0 +1,94 @@
+#!/bin/bash
+# Round-3 TPU recovery queue: re-runs phases that failed in tpu_queue.sh
+# because the axon tunnel dropped. Discipline (see
+# .claude/skills/verify/SKILL.md): ONE TPU process at a time, NEVER kill a
+# TPU client (wedges the lease 10-30 min), wait for the backend to come back
+# between phases instead of cascading failures.
+set -u
+cd /root/repo
+STATUS=/tmp/tpu_queue_v2.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+wait_backend() {
+  # Probe until jax.devices() works (cheap client; exits immediately after).
+  python - << 'EOF'
+import sys, time
+import jax
+for i in range(60):
+    try:
+        d = jax.devices()
+        print(f"backend ok: {d[0]}", file=sys.stderr)
+        sys.exit(0)
+    except Exception as e:
+        print(f"backend unavailable ({str(e)[:80]}); retry {i}", file=sys.stderr)
+        time.sleep(30)
+sys.exit(1)
+EOF
+}
+
+run_phase() {
+  # run_phase <name> <logfile> <cmd...>; retries twice, waiting for the
+  # backend before each attempt; marks success in $STATUS.
+  name=$1; logf=$2; shift 2
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
+    log "$name already done, skip"; return 0
+  fi
+  for attempt in 1 2 3; do
+    log "$name attempt $attempt: waiting for backend"
+    if ! wait_backend 2>> "$logf"; then
+      log "$name attempt $attempt: backend never came back"; continue
+    fi
+    log "$name attempt $attempt: start"
+    "$@" >> "$logf" 2>&1
+    rc=$?
+    log "$name attempt $attempt: rc=$rc"
+    if [ $rc -eq 0 ]; then echo "DONE $name" >> "$STATUS"; return 0; fi
+    sleep 120
+  done
+  return 1
+}
+
+log "queue v2 start"
+
+run_phase flash-hw /tmp/flash_hw.log \
+  env KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware
+
+run_phase bench_precond /tmp/bench_precond.out \
+  python scratch/bench_precond.py
+
+run_phase cifar-kfac /tmp/cifar_kfac.log \
+  python examples/train_cifar10_resnet.py \
+    --model resnet32 --epochs 40 --lr-decay 25 35 \
+    --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+    --precond-precision default --eigen-dtype bf16 \
+    --log-dir logs/cifar10_resnet32_kfac --checkpoint-dir /tmp/cc_kfac
+
+run_phase cifar-sgd /tmp/cifar_sgd.log \
+  python examples/train_cifar10_resnet.py \
+    --model resnet32 --epochs 40 --lr-decay 25 35 \
+    --kfac-update-freq 0 \
+    --log-dir logs/cifar10_resnet32_sgd --checkpoint-dir /tmp/cc_sgd
+
+run_phase wikitext /tmp/wikitext_kfac.log \
+  python examples/train_wikitext_rnn.py \
+    --data-dir /tmp/code-corpus --epochs 6 --batch-size 20 --bptt 35 \
+    --emsize 256 --nhid 256 --kfac-update-freq 10 \
+    --log-dir logs/wikitext_lstm_kfac
+
+run_phase transformer /tmp/transformer_kfac.log \
+  python examples/train_transformer_lm.py \
+    --data-dir /tmp/code-corpus --epochs 4 --batch-size 16 --seq-len 128 \
+    --d-model 256 --n-layers 2 --kfac-update-freq 10 \
+    --log-dir logs/transformer_lm_kfac
+
+run_phase imagenet-pipe /tmp/imagenet_pipe.log \
+  python examples/train_imagenet_resnet.py \
+    --data-dir /tmp/fake_imagenet256 --model resnet50 --epochs 1 \
+    --batch-size 32 --val-batch-size 32 --kfac-update-freq 10 \
+    --kfac-cov-update-freq 10 --checkpoint-dir "" \
+    --log-dir logs/imagenet_pipe_smoke
+
+run_phase bench /tmp/bench_final.out \
+  sh -c 'python bench.py > /tmp/bench_final.json'
+
+log "queue v2 done"
