@@ -174,7 +174,10 @@ def precondition_all(
     """
     diag_a = diag_a_names(eigen)
     out: Dict[str, jnp.ndarray] = {}
-    for name in diag_a:
+    # sorted: set iteration order varies per process under hash
+    # randomization, and dict insertion order feeds the KL-clip summation
+    # order — cross-host bitwise determinism requires a fixed order
+    for name in sorted(diag_a):
         e = eigen[name]
         out[name] = precondition_mat_embed(
             grad_mats[name], e["QG"], e["dG"], e["dA"], damping, precision
@@ -483,7 +486,10 @@ def precondition_all_inv(
     same stack layout contract)."""
     diag_a = diag_a_names(inv)
     out: Dict[str, jnp.ndarray] = {}
-    for name in diag_a:
+    # sorted: set iteration order varies per process under hash
+    # randomization, and dict insertion order feeds the KL-clip summation
+    # order — cross-host bitwise determinism requires a fixed order
+    for name in sorted(diag_a):
         e = inv[name]
         out[name] = precondition_mat_inv_embed(
             grad_mats[name], e["iA_diag"], e["iG"], precision

@@ -10,20 +10,18 @@ STATUS=/tmp/tpu_queue_v2.status
 log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
 
 wait_backend() {
-  # Probe until jax.devices() works (cheap client; exits immediately after).
-  python - << 'EOF'
-import sys, time
-import jax
-for i in range(60):
-    try:
-        d = jax.devices()
-        print(f"backend ok: {d[0]}", file=sys.stderr)
-        sys.exit(0)
-    except Exception as e:
-        print(f"backend unavailable ({str(e)[:80]}); retry {i}", file=sys.stderr)
-        time.sleep(30)
-sys.exit(1)
-EOF
+  # Probe until jax.devices() works. Each probe is its own process under
+  # `timeout`: when the relay is dead, clients sometimes HANG in recvmsg
+  # instead of raising (observed 07-30: phase4 sat 9 min at 0% CPU), and
+  # killing a client of a DEAD backend cannot wedge a lease — there is none.
+  for i in $(seq 1 60); do
+    if timeout 90 python -c "import jax; print(jax.devices()[0])"; then
+      return 0
+    fi
+    echo "backend probe $i failed; sleeping 30s" >&2
+    sleep 30
+  done
+  return 1
 }
 
 run_phase() {
