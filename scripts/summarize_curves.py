@@ -5,9 +5,11 @@ Usage:
     python scripts/summarize_curves.py logs/cifar10_resnet32_kfac [logs/...]
     python scripts/summarize_curves.py --compare logs/..._kfac logs/..._sgd
 
-With --compare, prints per-epoch val accuracy side by side and the fraction
-of epochs where the first run >= the second (the reference's headline claim
-is K-FAC >= SGD accuracy per epoch, README.md:57-60).
+With --compare, prints the chosen --tag (default val/accuracy; if either
+run lacks it, falls back to a shared same-direction tag) per epoch side by
+side and the fraction of epochs where the first run is at least as good —
+">=" for accuracy-like tags, "<=" for loss/ppl (the reference's headline
+claim is K-FAC >= SGD accuracy per epoch, README.md:57-60).
 """
 
 from __future__ import annotations
@@ -16,6 +18,10 @@ import argparse
 import json
 import os
 from collections import defaultdict
+
+
+def lower_is_better(tag: str) -> bool:
+    return "loss" in tag or "ppl" in tag
 
 
 def load(run_dir: str):
@@ -32,7 +38,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("runs", nargs="+")
     ap.add_argument("--compare", action="store_true")
-    ap.add_argument("--tag", default="val/accuracy")
+    ap.add_argument("--tag", default=None,
+                    help="comparison tag (default: val/accuracy, falling "
+                         "back to val/loss then val/ppl); an EXPLICIT tag "
+                         "missing from either run is an error, never a "
+                         "silent substitution")
     args = ap.parse_args()
 
     if not args.compare:
@@ -43,26 +53,50 @@ def main():
                 steps = sorted(series[tag])
                 vals = [series[tag][s] for s in steps]
                 # lower-is-better tags: loss / perplexity
-                best = min(vals) if ("loss" in tag or "ppl" in tag) else max(vals)
+                best = min(vals) if lower_is_better(tag) else max(vals)
                 print(
                     f"  {tag}: {len(steps)} points, first {vals[0]:.4f}, "
                     f"best {best:.4f}, last {vals[-1]:.4f}"
                 )
         return
 
-    a, b = args.runs[0], args.runs[1]
-    sa, sb = load(a)[args.tag], load(b)[args.tag]
+    if len(args.runs) != 2:
+        raise SystemExit("--compare takes exactly two run directories")
+    a, b = args.runs
+    la, lb = load(a), load(b)
+    tag = args.tag
+    if tag is not None and (tag not in la or tag not in lb):
+        # an explicitly requested tag must never be silently substituted
+        raise SystemExit(
+            f"tag {tag!r} missing from a run "
+            f"(have {sorted(la)} vs {sorted(lb)})"
+        )
+    if tag is None:
+        shared = [t for t in ("val/accuracy", "val/loss", "val/ppl")
+                  if t in la and t in lb]
+        if not shared:
+            raise SystemExit(
+                f"no shared comparison tag between {a} and {b} "
+                f"(have {sorted(la)} vs {sorted(lb)})"
+            )
+        tag = shared[0]
+        print(f"(comparing {tag!r})")
+    lower_better = lower_is_better(tag)
+    sa, sb = la[tag], lb[tag]
     steps = sorted(set(sa) & set(sb))
     wins = 0
     print(f"epoch  {os.path.basename(a):>24}  {os.path.basename(b):>24}")
     for s in steps:
-        mark = ">=" if sa[s] >= sb[s] else "< "
-        wins += sa[s] >= sb[s]
+        better = sa[s] <= sb[s] if lower_better else sa[s] >= sb[s]
+        wins += better
+        mark = ("<=" if lower_better else ">=") if better else ("> " if lower_better else "< ")
         print(f"{s:5d}  {sa[s]:24.4f}  {mark} {sb[s]:22.4f}")
+    best = min if lower_better else max
+    word = "<=" if lower_better else ">="
     print(
-        f"\n{args.tag}: {os.path.basename(a)} >= {os.path.basename(b)} on "
-        f"{wins}/{len(steps)} epochs; best {max(sa.values()):.4f} vs "
-        f"{max(sb.values()):.4f}"
+        f"\n{tag}: {os.path.basename(a)} {word} {os.path.basename(b)} on "
+        f"{wins}/{len(steps)} epochs; best {best(sa.values()):.4f} vs "
+        f"{best(sb.values()):.4f}"
     )
 
 

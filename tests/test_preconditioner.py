@@ -412,3 +412,37 @@ def test_precondition_assignment_balanced_and_deterministic():
     # more devices than layers: each layer still has exactly one owner in range
     owners_big = precondition_assignment(shapes, 64)
     assert all(0 <= d < 64 for d in owners_big.values())
+
+
+def test_distributed_precondition_conv_model():
+    """Conv layers (4-D kernels, channel-major grad flattening) through the
+    owner-sharded path: distributed == replicated on repeated conv shapes."""
+    from kfac_pytorch_tpu.ops import factors as F
+
+    rng = np.random.RandomState(9)
+    params, a_c, g_s, grads = {}, {}, {}, {}
+    # three same-shape convs (stacked group) + one distinct (singleton)
+    for i, (cin, cout) in enumerate([(4, 6), (4, 6), (4, 6), (6, 3)]):
+        name = f"c{i}"
+        params[name] = {"kernel": jnp.asarray(
+            rng.randn(3, 3, cin, cout).astype(np.float32))}
+        acts = jnp.asarray(rng.randn(2, 8, 8, cin).astype(np.float32))
+        gout = jnp.asarray(rng.randn(2, 8, 8, cout).astype(np.float32) / 128)
+        a_c[name] = F.compute_a_conv(
+            acts, (3, 3), (1, 1), "SAME", has_bias=False)
+        g_s[name] = F.compute_g_conv(gout, batch_averaged=True)
+        grads[name] = {"kernel": jnp.asarray(
+            rng.randn(3, 3, cin, cout).astype(np.float32))}
+
+    kw = dict(a_contribs=a_c, g_factor_stats=g_s, lr=0.1, damping=0.01,
+              update_factors=True, update_eigen=True)
+    kfac_rep = KFAC(damping=0.01)
+    g_rep, s_rep = kfac_rep.update(grads, kfac_rep.init(params), **kw)
+    assert s_rep["eigen_stacked"], "conv group must stack"
+    mesh = data_parallel_mesh()
+    kfac_d = KFAC(damping=0.01, mesh=mesh, distribute_precondition=True)
+    g_d, _ = kfac_d.update(grads, kfac_d.init(params), **kw)
+    for n in params:
+        np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
+                                   np.asarray(g_d[n]["kernel"]),
+                                   rtol=1e-4, atol=1e-5)

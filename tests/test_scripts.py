@@ -58,3 +58,26 @@ def test_make_code_corpus(tmp_path):
     assert set(splits) == {"train", "valid", "test"}
     assert 2 < len(words) <= 52
     assert splits["train"].dtype == np.int32
+
+
+def test_summarize_curves_compare_fallback(tmp_path):
+    """--compare falls back to a shared lower-is-better tag when the runs
+    have no val/accuracy (LM logs), and counts wins with <= semantics."""
+    import json
+    import subprocess
+    import sys
+
+    for name, vals in (("a", [3.0, 2.0]), ("b", [3.5, 2.5])):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / "scalars.jsonl", "w") as fh:
+            for step, v in enumerate(vals):
+                fh.write(json.dumps(
+                    {"tag": "val/loss", "step": step, "value": v}) + "\n")
+    out = subprocess.run(
+        [sys.executable, "scripts/summarize_curves.py", "--compare",
+         str(tmp_path / "a"), str(tmp_path / "b")],
+        capture_output=True, text=True, cwd=REPO, check=True,
+    ).stdout
+    assert "(comparing 'val/loss')" in out
+    assert "on 2/2 epochs" in out
