@@ -102,6 +102,11 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--init-from-torch", default=None,
+                   help="initialize model weights from a reference/"
+                        "torchvision ResNet checkpoint (.pth/.pth.tar, "
+                        "bare state_dict or the reference's {'model': ...} "
+                        "wrapper); optimizer and K-FAC state start fresh")
     p.add_argument("--precond-comm-dtype", default=None,
                    choices=[None, "bf16"],
                    help="downcast the distributed-precondition psum payload "
@@ -167,6 +172,40 @@ def main(argv=None):
     init_images = jnp.zeros((global_bs, im, im, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_images, train=True)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    if args.init_from_torch:
+        # migrate a reference/torchvision checkpoint (torch_interop.py);
+        # paths, SHAPES, and dtypes must all match the freshly-initialized
+        # tree (same key naming across resnet50/wide_resnet50_2 or a
+        # fine-tuned class count would otherwise fail deep inside the
+        # jitted step — or silently train in the checkpoint's fp16)
+        from kfac_pytorch_tpu import torch_interop
+
+        t_params, t_stats = torch_interop.load_torch_checkpoint(
+            args.init_from_torch, args.model
+        )
+
+        def _specs(tree):
+            return {
+                "/".join(str(k.key) for k in path): (v.shape, str(np.asarray(v).dtype) if not hasattr(v, "dtype") else str(v.dtype))
+                for path, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+            }
+
+        for have, want, coll in ((t_params, params, "params"),
+                                 (t_stats, batch_stats, "batch_stats")):
+            sh, sw = _specs(have), _specs(want)
+            if sh != sw:
+                diffs = [k for k in (sh.keys() | sw.keys())
+                         if sh.get(k) != sw.get(k)]
+                raise SystemExit(
+                    f"--init-from-torch {coll} mismatch for {args.model} "
+                    f"(first differing leaves: {sorted(diffs)[:4]}) — wrong "
+                    f"arch, class count, or checkpoint dtype?"
+                )
+        params = jax.tree_util.tree_map(jnp.asarray, t_params)
+        batch_stats = jax.tree_util.tree_map(jnp.asarray, t_stats)
+        if launch.is_primary():
+            print(f"initialized weights from torch checkpoint "
+                  f"{args.init_from_torch}")
 
     use_kfac = args.kfac_update_freq > 0
     lr_base = args.base_lr * world
@@ -205,6 +244,14 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        if resume_from_epoch and args.init_from_torch:
+            raise SystemExit(
+                f"--init-from-torch was given but {args.checkpoint_dir} "
+                f"holds an epoch-{resume_from_epoch - 1} checkpoint that "
+                "auto-resume just restored over the migrated weights; "
+                "point --checkpoint-dir at a fresh directory to start from "
+                "the torch checkpoint, or drop --init-from-torch to resume"
+            )
         # all hosts must agree on the epoch (the reference broadcasts it,
         # pytorch_imagenet_resnet.py:136-140)
         resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))

@@ -1,0 +1,153 @@
+"""Import reference/torchvision ResNet checkpoints into flax param pytrees.
+
+Migration path for users switching from the reference: its trainers save
+``{'model': state_dict, 'optimizer': ...}`` per epoch (examples/utils.py:
+10-17, pytorch_imagenet_resnet.py:365) with torchvision ResNet naming
+(``conv1``, ``bn1``, ``layer{1..4}.{i}.conv{j}/bn{j}/downsample``, ``fc`` —
+examples/imagenet_resnet.py). This module maps that state_dict onto the
+flax ``ImageNetResNet`` tree (models/imagenet_resnet.py), handling the
+layout differences:
+
+* conv weights: torch OIHW → flax HWIO (transpose)
+* linear weights: torch ``[out, in]`` → flax kernel ``[in, out]``
+* BatchNorm: ``weight``→``scale``; ``running_mean/var`` → ``batch_stats``
+* module naming: torch's nested ``layer{s}.{i}`` blocks → flax's flat
+  auto-numbered ``BasicBlock_i``/``Bottleneck_i`` (same traversal order)
+
+Grouped-conv variants (ResNeXt) are rejected: their grouped 3×3 is excluded
+from K-FAC here and uses a different module layout (imagenet_resnet.py
+top-of-file note), so a converted checkpoint could not be preconditioned
+equivalently anyway.
+
+Everything is numpy-only — tensors are accepted as anything
+``np.asarray`` understands (torch CPU tensors included), so this module
+never imports torch itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+# stage layouts of the supported zoo (models/imagenet_resnet.py::_make)
+_ARCHS = {
+    "resnet18": ("basic", [2, 2, 2, 2]),
+    "resnet34": ("basic", [3, 4, 6, 3]),
+    "resnet50": ("bottleneck", [3, 4, 6, 3]),
+    "resnet101": ("bottleneck", [3, 4, 23, 3]),
+    "resnet152": ("bottleneck", [3, 8, 36, 3]),
+    "wide_resnet50_2": ("bottleneck", [3, 4, 6, 3]),
+    "wide_resnet101_2": ("bottleneck", [3, 4, 23, 3]),
+}
+
+
+def _np(t) -> np.ndarray:
+    a = np.asarray(t)
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)
+    return a
+
+
+def _conv_kernel(t) -> np.ndarray:
+    """OIHW → HWIO."""
+    return _np(t).transpose(2, 3, 1, 0)
+
+
+def convert_state_dict(
+    sd: Dict[str, Any], arch: str
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """torchvision-format ResNet ``state_dict`` → ``(params, batch_stats)``.
+
+    ``sd`` maps dotted torch names to tensors/arrays. Returns nested dicts
+    matching ``ImageNetResNet.init``'s ``params`` / ``batch_stats``
+    collections. Raises ``KeyError`` listing what is missing, and
+    ``ValueError`` for unsupported archs or leftover (unconsumed) weights —
+    a silent partial import would be a wrong checkpoint.
+    """
+    if arch not in _ARCHS:
+        supported = ", ".join(sorted(_ARCHS))
+        raise ValueError(
+            f"unsupported arch {arch!r} (supported: {supported}; ResNeXt's "
+            "grouped convs use a different K-FAC-exclusion layout)"
+        )
+    kind, stages = _ARCHS[arch]
+    block_name = "BasicBlock" if kind == "basic" else "Bottleneck"
+    n_convs = 2 if kind == "basic" else 3
+
+    sd = dict(sd)  # consumed destructively so leftovers are detectable
+    sd = {k: v for k, v in sd.items() if not k.endswith("num_batches_tracked")}
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+
+    def take(key):
+        try:
+            return sd.pop(key)
+        except KeyError:
+            raise KeyError(
+                f"state_dict is missing {key!r} — is this really {arch}?"
+            ) from None
+
+    def put_bn(torch_prefix, flax_parent_p, flax_parent_s, flax_name):
+        flax_parent_p[flax_name] = {
+            "scale": _np(take(f"{torch_prefix}.weight")),
+            "bias": _np(take(f"{torch_prefix}.bias")),
+        }
+        flax_parent_s[flax_name] = {
+            "mean": _np(take(f"{torch_prefix}.running_mean")),
+            "var": _np(take(f"{torch_prefix}.running_var")),
+        }
+
+    # stem
+    params["KFACConv_0"] = {"kernel": _conv_kernel(take("conv1.weight"))}
+    put_bn("bn1", params, stats, "BatchNorm_0")
+
+    # blocks, in the same traversal order as ImageNetResNet.__call__
+    b = 0
+    for stage, blocks in enumerate(stages):
+        for i in range(blocks):
+            tp = f"layer{stage + 1}.{i}"
+            fp: Dict[str, Any] = {}
+            fs: Dict[str, Any] = {}
+            for j in range(n_convs):
+                fp[f"KFACConv_{j}"] = {
+                    "kernel": _conv_kernel(take(f"{tp}.conv{j + 1}.weight"))
+                }
+                put_bn(f"{tp}.bn{j + 1}", fp, fs, f"BatchNorm_{j}")
+            if f"{tp}.downsample.0.weight" in sd:
+                fp[f"KFACConv_{n_convs}"] = {
+                    "kernel": _conv_kernel(take(f"{tp}.downsample.0.weight"))
+                }
+                put_bn(f"{tp}.downsample.1", fp, fs, f"BatchNorm_{n_convs}")
+            params[f"{block_name}_{b}"] = fp
+            stats[f"{block_name}_{b}"] = fs
+            b += 1
+
+    # classifier
+    params["KFACDense_0"] = {
+        "kernel": _np(take("fc.weight")).T,
+        "bias": _np(take("fc.bias")),
+    }
+
+    if sd:
+        raise ValueError(
+            f"unconsumed state_dict entries (naming mismatch?): "
+            f"{sorted(sd)[:8]}{' ...' if len(sd) > 8 else ''}"
+        )
+    return params, stats
+
+
+def load_torch_checkpoint(path: str, arch: str):
+    """Read a reference checkpoint file and convert it.
+
+    Accepts both the reference's ``{'model': state_dict, ...}`` wrapper
+    (examples/utils.py:10-17) and a bare state_dict. Uses
+    ``torch.load(map_location='cpu')`` — the one place torch is imported,
+    and only when actually reading a torch file.
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    sd = obj.get("model", obj) if isinstance(obj, dict) else obj
+    sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
+    return convert_state_dict(sd, arch)
