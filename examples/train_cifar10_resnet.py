@@ -97,6 +97,10 @@ def parse_args(argv=None):
                    default=None, nargs="?")
     p.add_argument("--kfac-update-freq-alpha", type=float, default=10)
     p.add_argument("--kfac-update-freq-schedule", nargs="+", type=int, default=None)
+    p.add_argument("--init-from-torch", default=None,
+                   help="initialize model weights from a reference CIFAR "
+                        "ResNet checkpoint (.pth/.pth.tar); optimizer and "
+                        "K-FAC state start fresh")
     p.add_argument("--precond-comm-dtype", default=None,
                    choices=[None, "bf16"],
                    help="downcast the distributed-precondition psum payload "
@@ -147,6 +151,18 @@ def main(argv=None):
     init_images = jnp.zeros((global_bs, 32, 32, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_images, train=True)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    if args.init_from_torch:
+        # migrate a reference/torchvision checkpoint; validation of
+        # paths/shapes/dtypes lives with the converter
+        # (torch_interop.init_params_from_checkpoint)
+        from kfac_pytorch_tpu import torch_interop
+
+        params, batch_stats = torch_interop.init_params_from_checkpoint(
+            args.init_from_torch, args.model, params, batch_stats
+        )
+        if launch.is_primary():
+            print(f"initialized weights from torch checkpoint "
+                  f"{args.init_from_torch}")
 
     use_kfac = args.kfac_update_freq > 0
     lr_base = args.base_lr * world
@@ -195,6 +211,13 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        if resume_from_epoch and args.init_from_torch:
+            raise SystemExit(
+                f"--init-from-torch was given but {args.checkpoint_dir} "
+                f"holds an epoch-{resume_from_epoch - 1} checkpoint that "
+                "auto-resume just restored over the migrated weights; use a "
+                "fresh --checkpoint-dir or drop --init-from-torch"
+            )
         # hosts must agree (checkpoints may live on host-local disk and only
         # the primary writes them; the reference broadcasts the epoch too,
         # pytorch_imagenet_resnet.py:136-140)

@@ -173,36 +173,14 @@ def main(argv=None):
     variables = model.init(jax.random.PRNGKey(args.seed), init_images, train=True)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
     if args.init_from_torch:
-        # migrate a reference/torchvision checkpoint (torch_interop.py);
-        # paths, SHAPES, and dtypes must all match the freshly-initialized
-        # tree (same key naming across resnet50/wide_resnet50_2 or a
-        # fine-tuned class count would otherwise fail deep inside the
-        # jitted step — or silently train in the checkpoint's fp16)
+        # migrate a reference/torchvision checkpoint; validation of
+        # paths/shapes/dtypes lives with the converter
+        # (torch_interop.init_params_from_checkpoint)
         from kfac_pytorch_tpu import torch_interop
 
-        t_params, t_stats = torch_interop.load_torch_checkpoint(
-            args.init_from_torch, args.model
+        params, batch_stats = torch_interop.init_params_from_checkpoint(
+            args.init_from_torch, args.model, params, batch_stats
         )
-
-        def _specs(tree):
-            return {
-                "/".join(str(k.key) for k in path): (v.shape, str(np.asarray(v).dtype) if not hasattr(v, "dtype") else str(v.dtype))
-                for path, v in jax.tree_util.tree_flatten_with_path(tree)[0]
-            }
-
-        for have, want, coll in ((t_params, params, "params"),
-                                 (t_stats, batch_stats, "batch_stats")):
-            sh, sw = _specs(have), _specs(want)
-            if sh != sw:
-                diffs = [k for k in (sh.keys() | sw.keys())
-                         if sh.get(k) != sw.get(k)]
-                raise SystemExit(
-                    f"--init-from-torch {coll} mismatch for {args.model} "
-                    f"(first differing leaves: {sorted(diffs)[:4]}) — wrong "
-                    f"arch, class count, or checkpoint dtype?"
-                )
-        params = jax.tree_util.tree_map(jnp.asarray, t_params)
-        batch_stats = jax.tree_util.tree_map(jnp.asarray, t_stats)
         if launch.is_primary():
             print(f"initialized weights from torch checkpoint "
                   f"{args.init_from_torch}")

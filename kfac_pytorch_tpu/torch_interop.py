@@ -54,6 +54,45 @@ def _conv_kernel(t) -> np.ndarray:
     return _np(t).transpose(2, 3, 1, 0)
 
 
+class _Consumer:
+    """Destructive state_dict reader shared by the converters: missing keys
+    and unconsumed leftovers both fail loudly (a silent partial import would
+    be a wrong checkpoint)."""
+
+    def __init__(self, sd: Dict[str, Any], arch: str):
+        self.sd = {
+            k: v for k, v in sd.items()
+            if not k.endswith("num_batches_tracked")
+        }
+        self.arch = arch
+
+    def take(self, key):
+        try:
+            return self.sd.pop(key)
+        except KeyError:
+            raise KeyError(
+                f"state_dict is missing {key!r} — is this really "
+                f"{self.arch}?"
+            ) from None
+
+    def put_bn(self, torch_prefix, flax_parent_p, flax_parent_s, flax_name):
+        flax_parent_p[flax_name] = {
+            "scale": _np(self.take(f"{torch_prefix}.weight")),
+            "bias": _np(self.take(f"{torch_prefix}.bias")),
+        }
+        flax_parent_s[flax_name] = {
+            "mean": _np(self.take(f"{torch_prefix}.running_mean")),
+            "var": _np(self.take(f"{torch_prefix}.running_var")),
+        }
+
+    def check_consumed(self):
+        if self.sd:
+            raise ValueError(
+                f"unconsumed state_dict entries (naming mismatch?): "
+                f"{sorted(self.sd)[:8]}{' ...' if len(self.sd) > 8 else ''}"
+            )
+
+
 def convert_state_dict(
     sd: Dict[str, Any], arch: str
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
@@ -75,32 +114,13 @@ def convert_state_dict(
     block_name = "BasicBlock" if kind == "basic" else "Bottleneck"
     n_convs = 2 if kind == "basic" else 3
 
-    sd = dict(sd)  # consumed destructively so leftovers are detectable
-    sd = {k: v for k, v in sd.items() if not k.endswith("num_batches_tracked")}
+    c = _Consumer(sd, arch)
     params: Dict[str, Any] = {}
     stats: Dict[str, Any] = {}
 
-    def take(key):
-        try:
-            return sd.pop(key)
-        except KeyError:
-            raise KeyError(
-                f"state_dict is missing {key!r} — is this really {arch}?"
-            ) from None
-
-    def put_bn(torch_prefix, flax_parent_p, flax_parent_s, flax_name):
-        flax_parent_p[flax_name] = {
-            "scale": _np(take(f"{torch_prefix}.weight")),
-            "bias": _np(take(f"{torch_prefix}.bias")),
-        }
-        flax_parent_s[flax_name] = {
-            "mean": _np(take(f"{torch_prefix}.running_mean")),
-            "var": _np(take(f"{torch_prefix}.running_var")),
-        }
-
     # stem
-    params["KFACConv_0"] = {"kernel": _conv_kernel(take("conv1.weight"))}
-    put_bn("bn1", params, stats, "BatchNorm_0")
+    params["KFACConv_0"] = {"kernel": _conv_kernel(c.take("conv1.weight"))}
+    c.put_bn("bn1", params, stats, "BatchNorm_0")
 
     # blocks, in the same traversal order as ImageNetResNet.__call__
     b = 0
@@ -111,29 +131,76 @@ def convert_state_dict(
             fs: Dict[str, Any] = {}
             for j in range(n_convs):
                 fp[f"KFACConv_{j}"] = {
-                    "kernel": _conv_kernel(take(f"{tp}.conv{j + 1}.weight"))
+                    "kernel": _conv_kernel(c.take(f"{tp}.conv{j + 1}.weight"))
                 }
-                put_bn(f"{tp}.bn{j + 1}", fp, fs, f"BatchNorm_{j}")
-            if f"{tp}.downsample.0.weight" in sd:
+                c.put_bn(f"{tp}.bn{j + 1}", fp, fs, f"BatchNorm_{j}")
+            if f"{tp}.downsample.0.weight" in c.sd:
                 fp[f"KFACConv_{n_convs}"] = {
-                    "kernel": _conv_kernel(take(f"{tp}.downsample.0.weight"))
+                    "kernel": _conv_kernel(c.take(f"{tp}.downsample.0.weight"))
                 }
-                put_bn(f"{tp}.downsample.1", fp, fs, f"BatchNorm_{n_convs}")
+                c.put_bn(f"{tp}.downsample.1", fp, fs, f"BatchNorm_{n_convs}")
             params[f"{block_name}_{b}"] = fp
             stats[f"{block_name}_{b}"] = fs
             b += 1
 
     # classifier
     params["KFACDense_0"] = {
-        "kernel": _np(take("fc.weight")).T,
-        "bias": _np(take("fc.bias")),
+        "kernel": _np(c.take("fc.weight")).T,
+        "bias": _np(c.take("fc.bias")),
     }
+    c.check_consumed()
+    return params, stats
 
-    if sd:
+
+_CIFAR_DEPTHS = {20, 32, 44, 56, 110, 1202}
+
+
+def convert_cifar_state_dict(
+    sd: Dict[str, Any], arch: str
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Reference CIFAR ResNet ``state_dict`` → ``(params, batch_stats)``.
+
+    The reference CIFAR zoo (examples/cifar_resnet.py) names its modules
+    ``conv1/bn1``, ``layer{1..3}.{i}.conv{1,2}/bn{1,2}``, and ``linear``;
+    option-A shortcuts are parameter-free (pad + stride), so blocks never
+    carry downsample weights. Depth must satisfy ``depth = 6n + 2``
+    (resnet20/32/44/56/110/1202).
+    """
+    suffix = arch[len("resnet"):] if arch.startswith("resnet") else ""
+    if not suffix.isdigit():
+        raise ValueError(f"unsupported cifar arch {arch!r}")
+    depth = int(suffix)
+    if depth not in _CIFAR_DEPTHS:
         raise ValueError(
-            f"unconsumed state_dict entries (naming mismatch?): "
-            f"{sorted(sd)[:8]}{' ...' if len(sd) > 8 else ''}"
+            f"unsupported cifar arch {arch!r} (supported: "
+            f"{sorted('resnet%d' % d for d in _CIFAR_DEPTHS)})"
         )
+    n = (depth - 2) // 6
+
+    c = _Consumer(sd, arch)
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    params["KFACConv_0"] = {"kernel": _conv_kernel(c.take("conv1.weight"))}
+    c.put_bn("bn1", params, stats, "BatchNorm_0")
+    b = 0
+    for stage in range(3):
+        for i in range(n):
+            tp = f"layer{stage + 1}.{i}"
+            fp: Dict[str, Any] = {}
+            fs: Dict[str, Any] = {}
+            for j in (1, 2):
+                fp[f"KFACConv_{j - 1}"] = {
+                    "kernel": _conv_kernel(c.take(f"{tp}.conv{j}.weight"))
+                }
+                c.put_bn(f"{tp}.bn{j}", fp, fs, f"BatchNorm_{j - 1}")
+            params[f"BasicBlock_{b}"] = fp
+            stats[f"BasicBlock_{b}"] = fs
+            b += 1
+    params["KFACDense_0"] = {
+        "kernel": _np(c.take("linear.weight")).T,
+        "bias": _np(c.take("linear.bias")),
+    }
+    c.check_consumed()
     return params, stats
 
 
@@ -150,4 +217,44 @@ def load_torch_checkpoint(path: str, arch: str):
     obj = torch.load(path, map_location="cpu", weights_only=True)
     sd = obj.get("model", obj) if isinstance(obj, dict) else obj
     sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
+    # the CIFAR zoo heads with `linear`, the ImageNet zoo with `fc`
+    # (examples/cifar_resnet.py vs examples/imagenet_resnet.py)
+    if "linear.weight" in sd:
+        return convert_cifar_state_dict(sd, arch)
     return convert_state_dict(sd, arch)
+
+
+def init_params_from_checkpoint(path: str, arch: str, params, batch_stats):
+    """Trainer-facing migration: load, convert, and validate against a
+    freshly-initialized tree.
+
+    Paths, SHAPES, and dtypes must all match — the same key naming across
+    e.g. resnet50/wide_resnet50_2 or a fine-tuned class count would
+    otherwise fail deep inside the jitted step, and an fp16-saved
+    checkpoint would silently train in fp16. Returns
+    ``(params, batch_stats)`` as jnp arrays; raises ``SystemExit`` with the
+    first differing leaves on mismatch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    t_params, t_stats = load_torch_checkpoint(path, arch)
+
+    def _specs(tree):
+        return {
+            "/".join(str(k.key) for k in pth): (v.shape, str(np.asarray(v).dtype))
+            for pth, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+        }
+
+    for have, want, coll in ((t_params, params, "params"),
+                             (t_stats, batch_stats, "batch_stats")):
+        sh, sw = _specs(have), _specs(want)
+        if sh != sw:
+            diffs = [k for k in (sh.keys() | sw.keys()) if sh.get(k) != sw.get(k)]
+            raise SystemExit(
+                f"--init-from-torch {coll} mismatch for {arch} (first "
+                f"differing leaves: {sorted(diffs)[:4]}) — wrong arch, "
+                f"class count, or checkpoint dtype?"
+            )
+    return (jax.tree_util.tree_map(jnp.asarray, t_params),
+            jax.tree_util.tree_map(jnp.asarray, t_stats))

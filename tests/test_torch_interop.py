@@ -177,3 +177,81 @@ def test_reference_checkpoint_wrapper_roundtrip(tmp_path):
         params["KFACConv_0"]["kernel"],
         net.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0),
     )
+
+
+class _CifarBasic(tnn.Module):
+    """Option-A block: parameter-free pad/stride shortcut."""
+
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, planes, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.pad = planes - cin if (stride != 1 or cin != planes) else 0
+        self.stride = stride
+
+    def forward(self, x):
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        if self.pad:
+            x = torch.nn.functional.pad(
+                x[:, :, ::2, ::2], (0, 0, 0, 0, self.pad // 2, self.pad // 2)
+            )
+        return torch.relu(y + x)
+
+
+class _TorchCifarResNet(tnn.Module):
+    """Reference CIFAR naming: conv1/bn1, layer1-3, linear."""
+
+    def __init__(self, n, num_classes=10):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 16, 3, 1, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(16)
+        cin = 16
+        for s, planes in enumerate((16, 32, 64)):
+            blocks = []
+            for i in range(n):
+                stride = 2 if (s > 0 and i == 0) else 1
+                blocks.append(_CifarBasic(cin, planes, stride))
+                cin = planes
+            setattr(self, f"layer{s + 1}", tnn.Sequential(*blocks))
+        self.linear = tnn.Linear(64, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        for s in range(3):
+            x = getattr(self, f"layer{s + 1}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.linear(x)
+
+
+def test_cifar_resnet20_forward_equivalence():
+    from kfac_pytorch_tpu.models import cifar_resnet
+
+    torch.manual_seed(0)
+    net = _TorchCifarResNet(3).eval()
+    with torch.no_grad():
+        net.train()
+        net(torch.randn(8, 3, 32, 32))  # move BN running stats off-init
+        net.eval()
+    params, stats = torch_interop.convert_cifar_state_dict(
+        _numpy_sd(net), "resnet20")
+    x = np.random.RandomState(2).randn(4, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = net(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    model = cifar_resnet.get_model("resnet20")
+    got = model.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+def test_cifar_checkpoint_family_dispatch(tmp_path):
+    torch.manual_seed(0)
+    net = _TorchCifarResNet(3)
+    path = tmp_path / "checkpoint-99.pth.tar"
+    torch.save({"model": net.state_dict(), "optimizer": {}}, path)
+    params, _ = torch_interop.load_torch_checkpoint(str(path), "resnet20")
+    assert "BasicBlock_8" in params and "KFACDense_0" in params
+    with pytest.raises(ValueError, match="unsupported cifar arch"):
+        torch_interop.convert_cifar_state_dict(_numpy_sd(net), "resnet21")
