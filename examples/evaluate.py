@@ -1,0 +1,137 @@
+"""Standalone checkpoint evaluation on the ImageNet-format .npy shards.
+
+The switch-over companion to ``--init-from-torch``: validate a migrated
+reference checkpoint (or one of this framework's orbax checkpoints) on the
+full val split without running a training epoch. The reference has no such
+tool — its accuracy numbers only ever come out of the training loop
+(pytorch_imagenet_resnet.py validate()).
+
+    # evaluate a reference checkpoint right after migrating it
+    python examples/evaluate.py --data-dir /data/imagenet-shards \
+        --model resnet50 --init-from-torch checkpoint-54.pth.tar
+
+    # evaluate this framework's newest orbax checkpoint
+    python examples/evaluate.py --data-dir ... --model resnet50 \
+        --checkpoint-dir ./checkpoints
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _env  # noqa: F401  (platform forcing — must precede jax use)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import runtime
+from kfac_pytorch_tpu.models import imagenet_resnet
+from kfac_pytorch_tpu.parallel import launch
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.training import checkpoint as ckpt
+from kfac_pytorch_tpu.training import evaluation
+from kfac_pytorch_tpu.training.step import TrainState, make_masked_eval_step
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", required=True, help="npy shard dir (val_x/val_y)")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint dir (newest epoch is evaluated)")
+    p.add_argument("--init-from-torch", default=None,
+                   help="reference/torchvision checkpoint (.pth/.pth.tar)")
+    p.add_argument("--batch-size", type=int, default=256, help="per-device")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--val-resize", type=int, default=256)
+    p.add_argument("--label-smoothing", type=float, default=0.1)
+    p.add_argument("--num-workers", type=int, default=4)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if bool(args.checkpoint_dir) == bool(args.init_from_torch):
+        raise SystemExit(
+            "give exactly one of --checkpoint-dir or --init-from-torch"
+        )
+    if args.val_resize < args.image_size:
+        raise SystemExit(
+            f"--val-resize ({args.val_resize}) must be >= --image-size "
+            f"({args.image_size}): Resize(shorter side) must cover the "
+            "CenterCrop (the transform would replicate borders and report "
+            "plausible but wrong metrics otherwise)"
+        )
+
+    launch.initialize()
+    mesh = data_parallel_mesh()
+    world, n_proc = mesh.devices.size, launch.size()
+
+    xp = os.path.join(args.data_dir, "val_x.npy")
+    yp = os.path.join(args.data_dir, "val_y.npy")
+    x_val = np.load(xp, mmap_mode="r")
+    y_val = np.load(yp)
+
+    model = imagenet_resnet.get_model(args.model)
+    init = jnp.zeros((world, args.image_size, args.image_size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), init, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+
+    if args.init_from_torch:
+        from kfac_pytorch_tpu import torch_interop
+
+        params, batch_stats = torch_interop.init_params_from_checkpoint(
+            args.init_from_torch, args.model, params, batch_stats
+        )
+        source = args.init_from_torch
+    else:
+        # template-free restore: the saved TrainState carries optimizer +
+        # K-FAC slots this tool does not (training/checkpoint.py::
+        # restore_weights_only)
+        epoch = ckpt.latest_epoch(args.checkpoint_dir)
+        if epoch is None:
+            raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
+        params, batch_stats = ckpt.restore_weights_only(
+            args.checkpoint_dir, epoch
+        )
+        source = f"{args.checkpoint_dir} (epoch {epoch})"
+
+    # weights-only state: the eval step reads params/batch_stats; a real
+    # opt_state would just replicate ~params-sized zero momentum buffers
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        batch_stats=batch_stats, opt_state={}, kfac_state=None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    eval_step = make_masked_eval_step(
+        model, label_smoothing=args.label_smoothing,
+        eval_kwargs={"train": False})
+    # host-uniform decision: mixed native/numpy transforms across hosts
+    # would make pod-global metric sums irreproducible (same consensus the
+    # trainer takes, train_imagenet_resnet.py)
+    use_native = bool(
+        launch.host_min(args.num_workers > 0 and runtime.native_available())
+    )
+    loss, acc = evaluation.run_imagenet_validation(
+        eval_step, mesh, state, x_val, y_val,
+        image_size=args.image_size, val_resize=args.val_resize,
+        local_batch=args.batch_size * world // n_proc,
+        n_proc=n_proc, rank=launch.rank(),
+        use_native=use_native, num_workers=args.num_workers,
+    )
+    if launch.is_primary():
+        print(f"{args.model} from {source}: "
+              f"val loss={loss:.4f} top1={acc:.4f} ({len(y_val)} images)")
+    return loss, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,7 @@ from kfac_pytorch_tpu.training import (
 )
 from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
+from kfac_pytorch_tpu.training import evaluation
 from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import kfac_flags_for_step, make_sgd
@@ -403,51 +404,15 @@ def main(argv=None):
 
         if val_data is not None:
             x_val, y_val = val_data
-            # full-split masked eval; jitted sums are already pod-global
-            local_val_bs = args.val_batch_size * world // n_proc
-            vl_sum = vc_sum = vn = 0.0
-            # shards already stored at the crop size pass through (uint8
-            # still decodes+normalizes) — they were transformed at staging;
-            # re-running Resize+CenterCrop would zoom-crop them a second
-            # time. Mirrors the train-side stored==(im,im) case.
-            val_passthrough = tuple(x_val.shape[1:3]) == (im, im)
-            val_norm = (
-                dict(mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD)
-                if x_val.dtype == np.uint8 else {}
+            # full-split masked eval (training/evaluation.py — shared with
+            # examples/evaluate.py); jitted sums are already pod-global
+            val_loss, val_acc = evaluation.run_imagenet_validation(
+                eval_step, mesh, state, x_val, y_val,
+                image_size=im, val_resize=args.val_resize,
+                local_batch=args.val_batch_size * world // n_proc,
+                n_proc=n_proc, rank=launch.rank(),
+                use_native=use_native, num_workers=args.num_workers,
             )
-            for xb, yb, mb in data_lib.eval_batches(
-                x_val, y_val, local_val_bs,
-                num_shards=n_proc, shard_index=launch.rank(),
-            ):
-                # the reference eval stack (Resize + CenterCrop,
-                # pytorch_imagenet_resnet.py:180-193); native threaded
-                # transform when available, per-image numpy otherwise
-                if val_passthrough:
-                    if xb.dtype == np.uint8:
-                        xb = (
-                            np.asarray(xb, np.float32) / 255.0
-                            - data_lib.IMAGENET_MEAN
-                        ) / data_lib.IMAGENET_STD
-                    else:
-                        xb = np.asarray(xb, np.float32)
-                elif use_native:
-                    xb = runtime.native_transform(
-                        xb, (im, im), mode="centercrop",
-                        resize_size=args.val_resize,
-                        num_workers=args.num_workers, **val_norm,
-                    )
-                else:
-                    xb = data_lib.imagenet_eval_transform(
-                        xb, im, resize_size=args.val_resize
-                    )
-                yb = np.asarray(yb, np.int32)
-                m = jax.device_get(
-                    eval_step(state, put_global_batch(mesh, (xb, yb, mb)))
-                )
-                vl_sum += float(m["loss_sum"])
-                vc_sum += float(m["correct"])
-                vn += float(m["count"])
-            val_loss, val_acc = vl_sum / vn, vc_sum / vn
             if launch.is_primary():
                 print(f"  val: loss={val_loss:.4f} acc={val_acc:.4f}")
             writer.add_scalar("val/loss", val_loss, epoch)

@@ -55,6 +55,22 @@ def restore_checkpoint(
     return restored
 
 
+def restore_weights_only(
+    checkpoint_dir: str, epoch: int
+) -> Tuple[Any, Any]:
+    """``(params, batch_stats)`` from a saved TrainState, template-free.
+
+    For consumers that carry no optimizer/K-FAC slots (examples/evaluate.py):
+    a TrainState template with ``kfac_state=None`` cannot restore a
+    checkpoint whose K-FAC state is a real dict (orbax requires matching
+    structures), so restore the raw saved tree and pick the weight
+    collections out of it.
+    """
+    ckptr = ocp.PyTreeCheckpointer()
+    raw = ckptr.restore(checkpoint_path(checkpoint_dir, epoch))
+    return raw["params"], raw["batch_stats"]
+
+
 def auto_resume(
     checkpoint_dir: str, target: Any
 ) -> Tuple[Any, int]:

@@ -1,0 +1,72 @@
+"""Full-split masked validation over .npy shards — shared by the ImageNet
+trainer's per-epoch eval and the standalone ``examples/evaluate.py``.
+
+The reference evaluates with Resize + CenterCrop
+(pytorch_imagenet_resnet.py:180-193); here the transform runs in the native
+threaded loader when available, per-image numpy otherwise, and shards
+already stored at the crop size pass through (they were transformed at
+staging — re-running Resize+CenterCrop would zoom-crop them twice). Metric
+sums come back masked (ragged final batch) and already pod-global from the
+jitted eval step.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from kfac_pytorch_tpu import runtime
+from kfac_pytorch_tpu.parallel.mesh import put_global_batch
+from kfac_pytorch_tpu.training import data as data_lib
+
+
+def run_imagenet_validation(
+    eval_step,
+    mesh,
+    state,
+    x_val: np.ndarray,
+    y_val: np.ndarray,
+    *,
+    image_size: int,
+    val_resize: int,
+    local_batch: int,
+    n_proc: int = 1,
+    rank: int = 0,
+    use_native: bool = False,
+    num_workers: int = 4,
+) -> Tuple[float, float]:
+    """Evaluate the whole val split; returns ``(mean_loss, top1_accuracy)``."""
+    im = image_size
+    val_passthrough = tuple(x_val.shape[1:3]) == (im, im)
+    val_norm = (
+        dict(mean=data_lib.IMAGENET_MEAN, std=data_lib.IMAGENET_STD)
+        if x_val.dtype == np.uint8 else {}
+    )
+    vl_sum = vc_sum = vn = 0.0
+    for xb, yb, mb in data_lib.eval_batches(
+        x_val, y_val, local_batch, num_shards=n_proc, shard_index=rank
+    ):
+        if val_passthrough:
+            if xb.dtype == np.uint8:
+                xb = (
+                    np.asarray(xb, np.float32) / 255.0 - data_lib.IMAGENET_MEAN
+                ) / data_lib.IMAGENET_STD
+            else:
+                xb = np.asarray(xb, np.float32)
+        elif use_native:
+            xb = runtime.native_transform(
+                xb, (im, im), mode="centercrop", resize_size=val_resize,
+                num_workers=num_workers, **val_norm,
+            )
+        else:
+            xb = data_lib.imagenet_eval_transform(xb, im, resize_size=val_resize)
+        yb = np.asarray(yb, np.int32)
+        m = jax.device_get(
+            eval_step(state, put_global_batch(mesh, (xb, yb, mb)))
+        )
+        vl_sum += float(m["loss_sum"])
+        vc_sum += float(m["correct"])
+        vn += float(m["count"])
+    return vl_sum / vn, vc_sum / vn

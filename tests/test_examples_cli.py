@@ -64,3 +64,45 @@ def test_imagenet_trainer_rejects_undersized_val_resize(imagenet_shards):
             "--data-dir", str(imagenet_shards),
             "--image-size", "224", "--val-resize", "192",
         ])
+
+
+def test_evaluate_cli_matches_trainer_val(imagenet_shards, tmp_path):
+    """examples/evaluate.py on the trainer's checkpoint reproduces the
+    trainer's final val metrics (same weights, same shared eval path)."""
+    import json
+
+    import evaluate as ev
+    import train_imagenet_resnet as t
+
+    log_dir = tmp_path / "logs"
+    t.main([
+        "--data-dir", str(imagenet_shards),
+        "--image-size", "32", "--val-resize", "36",
+        "--model", "resnet18",
+        "--batch-size", "1", "--val-batch-size", "1",
+        "--epochs", "1", "--steps-per-epoch", "2",
+        "--kfac-update-freq", "2", "--kfac-cov-update-freq", "1",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--log-dir", str(log_dir),
+    ])
+    want = {
+        json.loads(l)["tag"]: json.loads(l)["value"]
+        for l in (log_dir / "scalars.jsonl").open()
+    }
+    loss, acc = ev.main([
+        "--data-dir", str(imagenet_shards),
+        "--model", "resnet18",
+        "--image-size", "32", "--val-resize", "36",
+        "--batch-size", "1", "--num-workers", "0",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert abs(loss - want["val/loss"]) < 1e-4
+    assert abs(acc - want["val/accuracy"]) < 1e-6
+
+
+def test_evaluate_cli_arg_validation(imagenet_shards):
+    import evaluate as ev
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        ev.main(["--data-dir", str(imagenet_shards), "--model", "resnet18"])
