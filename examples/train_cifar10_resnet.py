@@ -211,6 +211,12 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        # hosts must agree (checkpoints may live on host-local disk and only
+        # the primary writes them; the reference broadcasts the epoch too,
+        # pytorch_imagenet_resnet.py:136-140)
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
+        # checked only AFTER the broadcast: raising on a subset of hosts
+        # would leave the others hanging in the collective
         if resume_from_epoch and args.init_from_torch:
             raise SystemExit(
                 f"--init-from-torch was given but {args.checkpoint_dir} "
@@ -218,10 +224,6 @@ def main(argv=None):
                 "auto-resume just restored over the migrated weights; use a "
                 "fresh --checkpoint-dir or drop --init-from-torch"
             )
-        # hosts must agree (checkpoints may live on host-local disk and only
-        # the primary writes them; the reference broadcasts the epoch too,
-        # pytorch_imagenet_resnet.py:136-140)
-        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
         if resume_from_epoch and kfac_sched:
             kfac_sched.epoch = resume_from_epoch
         if resume_from_epoch and launch.is_primary():

@@ -223,6 +223,12 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        # all hosts must agree on the epoch (the reference broadcasts it,
+        # pytorch_imagenet_resnet.py:136-140)
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
+        # checked only AFTER the broadcast: raising on a subset of hosts
+        # (host-local checkpoint dirs) would leave the others hanging in
+        # the collective
         if resume_from_epoch and args.init_from_torch:
             raise SystemExit(
                 f"--init-from-torch was given but {args.checkpoint_dir} "
@@ -231,9 +237,6 @@ def main(argv=None):
                 "point --checkpoint-dir at a fresh directory to start from "
                 "the torch checkpoint, or drop --init-from-torch to resume"
             )
-        # all hosts must agree on the epoch (the reference broadcasts it,
-        # pytorch_imagenet_resnet.py:136-140)
-        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
         if resume_from_epoch and launch.is_primary():
             print(f"resumed from epoch {resume_from_epoch - 1}")
     if use_kfac:
