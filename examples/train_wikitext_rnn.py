@@ -30,6 +30,7 @@ import numpy as np
 
 from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
 from kfac_pytorch_tpu.models import wikitext_rnn
+from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
 from kfac_pytorch_tpu.training.lm_step import (
@@ -138,6 +139,11 @@ def main(argv=None):
     resume_from_epoch = 0
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
+        # hosts must agree on the resume epoch (checkpoints may be
+        # host-local; the reference broadcasts it too,
+        # pytorch_imagenet_resnet.py:136-140) — differing start epochs
+        # would desync the per-step collectives
+        resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
 
     train_step = make_lm_train_step(model, tx, kfac, grad_clip=args.clip)
     eval_step = make_lm_eval_step(model)

@@ -180,3 +180,50 @@ def test_assignment_diag_a_cost():
     assert not any(
         owners_old[f"d{i}"] == owners_old["emb"] for i in range(8)
     ), owners_old
+
+
+def test_embed_grad_shape_collision_with_dense_stack():
+    """An embedding whose grad shape equals a stacked dense group's must not
+    shift the stack's row indices (the diag_a exclusion contract shared by
+    _split_state and _stack_layout) — results must still match replicated
+    per-layer math."""
+    rng = np.random.RandomState(11)
+    # two dense layers with [out, in] factor shape (5, 11) (stacked group)
+    # + an embedding whose grad mat is also (DIM, VOCAB) == (5, 11),
+    # colliding with that group's shape
+    params = {
+        "d0": {"kernel": jnp.asarray(rng.randn(11, 5).astype(np.float32))},
+        "d1": {"kernel": jnp.asarray(rng.randn(11, 5).astype(np.float32))},
+        "emb": {"embedding": jnp.asarray(rng.randn(VOCAB, DIM).astype(np.float32))},
+    }
+    from kfac_pytorch_tpu.ops import factors as F2
+
+    a_c, g_s, grads = {}, {}, {}
+    for n in ("d0", "d1"):
+        acts = jnp.asarray(rng.randn(8, 11).astype(np.float32))
+        gout = jnp.asarray(rng.randn(8, 5).astype(np.float32) / 8)
+        a_c[n] = F2.compute_a_dense(acts, has_bias=False)
+        g_s[n] = F2.compute_g_dense(gout, batch_averaged=True)
+        grads[n] = {"kernel": jnp.asarray(rng.randn(11, 5).astype(np.float32))}
+    ids = jnp.asarray(rng.randint(0, VOCAB, size=(6, 7)).astype(np.int32))
+    gout = jnp.asarray(rng.randn(6, 7, DIM).astype(np.float32) / 42)
+    a_c["emb"] = F2.compute_a_embed(ids, VOCAB)
+    g_s["emb"] = F2.compute_g_dense(gout, batch_averaged=True)
+    grads["emb"] = {"embedding": jnp.asarray(
+        rng.randn(VOCAB, DIM).astype(np.float32))}
+
+    kw = dict(a_contribs=a_c, g_factor_stats=g_s, lr=0.1, damping=0.01,
+              update_factors=True, update_eigen=True)
+    for method in ("eigen", "inverse"):
+        kfac_rep = KFAC(damping=0.01, precond_method=method,
+                        layers=["d0", "d1", "emb"])
+        g_rep, s_rep = kfac_rep.update(grads, kfac_rep.init(params), **kw)
+        assert s_rep["eigen_stacked"], "dense pair must stack"
+        mesh = data_parallel_mesh()
+        kfac_d = KFAC(damping=0.01, precond_method=method, mesh=mesh,
+                      distribute_precondition=True, layers=["d0", "d1", "emb"])
+        g_d, _ = kfac_d.update(grads, kfac_d.init(params), **kw)
+        for n, key in (("d0", "kernel"), ("d1", "kernel"), ("emb", "embedding")):
+            np.testing.assert_allclose(
+                np.asarray(g_rep[n][key]), np.asarray(g_d[n][key]),
+                rtol=1e-4, atol=1e-5, err_msg=f"{method}/{n}")
