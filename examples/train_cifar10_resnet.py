@@ -106,6 +106,11 @@ def parse_args(argv=None):
                    help="downcast the distributed-precondition psum payload "
                         "(the reference's --fp16-allreduce compression, "
                         "applied to the preconditioned-grad exchange)")
+    p.add_argument("--grad-comm-dtype", default=None, choices=[None, "bf16"],
+                   help="downcast the per-step data-parallel gradient mean "
+                        "on the wire (the reference's --fp16-allreduce on "
+                        "DistributedOptimizer, pytorch_cifar10_resnet.py:"
+                        "190-195); None = exact f32 reduction")
     p.add_argument("--precond-method", default="eigen",
                    choices=["eigen", "inverse"],
                    help="eigen: reference-parity eigenbasis solve (damping "
@@ -124,6 +129,10 @@ def parse_args(argv=None):
                         "math stay f32)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
+    p.add_argument("--kfac-diagnostics", action="store_true",
+                   help="log per-epoch K-FAC stability telemetry (KL-clip "
+                        "coefficient nu min/mean, min damped eigenvalue) to "
+                        "--log-dir")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
@@ -191,6 +200,7 @@ def main(argv=None):
             precond_comm_dtype=(jnp.bfloat16
                                 if args.precond_comm_dtype == "bf16" else None),
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
+            track_diagnostics=args.kfac_diagnostics,
         )
         kfac_sched = KFACParamScheduler(
             kfac,
@@ -236,6 +246,8 @@ def main(argv=None):
         model, tx, kfac, label_smoothing=args.label_smoothing,
         train_kwargs={"train": True}, accum_steps=accum,
         stats_all_microbatches=args.stats_all_microbatches,
+        mesh=mesh if args.grad_comm_dtype else None,
+        grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
@@ -313,6 +325,18 @@ def main(argv=None):
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
+        nu_min, nu_sum, nu_n, eig_min = 1.0, 0.0, 0, None
+
+        def eat(m):
+            nonlocal nu_min, nu_sum, nu_n, eig_min
+            loss_m.update(m["loss"])
+            acc_m.update(m["accuracy"])
+            if "kfac_nu" in m:
+                nu = float(m["kfac_nu"])
+                nu_min, nu_sum, nu_n = min(nu_min, nu), nu_sum + nu, nu_n + 1
+                e = float(m["kfac_min_damped_eig"])
+                eig_min = e if eig_min is None else min(eig_min, e)
+
         # metrics fetched a few steps late: the loop stays async (no
         # per-step host sync) while the lag window bounds in-flight
         # batches/steps so queued input buffers can't accumulate in HBM
@@ -331,12 +355,9 @@ def main(argv=None):
                 step += 1
                 pending.append(metrics)
                 if len(pending) > 2:
-                    m = jax.device_get(pending.pop(0))
-                    loss_m.update(m["loss"])
-                    acc_m.update(m["accuracy"])
+                    eat(jax.device_get(pending.pop(0)))
             for m in jax.device_get(pending):
-                loss_m.update(m["loss"])
-                acc_m.update(m["accuracy"])
+                eat(m)
         dt = time.perf_counter() - t0
         imgs_per_sec = steps_per_epoch * global_bs * accum / dt
         if launch.is_primary():
@@ -347,6 +368,13 @@ def main(argv=None):
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/accuracy", acc_m.avg, epoch)
         writer.add_scalar("train/lr", lr, epoch)
+        if nu_n:
+            writer.add_scalar("kfac/nu_min", nu_min, epoch)
+            writer.add_scalar("kfac/nu_mean", nu_sum / nu_n, epoch)
+            writer.add_scalar("kfac/min_damped_eig", eig_min, epoch)
+            if launch.is_primary():
+                print(f"  kfac: nu_min={nu_min:.4f} nu_mean={nu_sum/nu_n:.4f} "
+                      f"min_damped_eig={eig_min:.3e}")
 
         if x_val is not None:
             # full-split masked eval: the jitted step reduces over the GLOBAL

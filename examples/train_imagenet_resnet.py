@@ -113,6 +113,10 @@ def parse_args(argv=None):
                    help="downcast the distributed-precondition psum payload "
                         "(the reference's --fp16-allreduce compression, "
                         "applied to the preconditioned-grad exchange)")
+    p.add_argument("--grad-comm-dtype", default=None, choices=[None, "bf16"],
+                   help="downcast the per-step data-parallel gradient mean "
+                        "on the wire (the reference's --fp16-allreduce on "
+                        "DistributedOptimizer); None = exact f32 reduction")
     p.add_argument("--precond-method", default="eigen",
                    choices=["eigen", "inverse"],
                    help="eigen: reference-parity eigenbasis solve (damping "
@@ -256,6 +260,8 @@ def main(argv=None):
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,
         train_kwargs={"train": True}, accum_steps=accum,
+        mesh=mesh if args.grad_comm_dtype else None,
+        grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}

@@ -82,6 +82,11 @@ def parse_args(argv=None):
     p.add_argument("--damping-alpha", type=float, default=0.5)
     p.add_argument("--damping-schedule", nargs="+", type=int, default=None)
     p.add_argument("--kl-clip", type=float, default=0.001)
+    p.add_argument("--grad-comm-dtype", default=None, choices=[None, "bf16"],
+                   help="downcast the per-step data-parallel gradient mean "
+                        "on the wire (the reference's --fp16-allreduce on "
+                        "DistributedOptimizer); pure-DP only "
+                        "(--seq-parallel 1)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--seed", type=int, default=42)
@@ -177,8 +182,16 @@ def main(argv=None):
         resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
     state = jax.device_put(state, NamedSharding(mesh, P()))
 
+    if args.grad_comm_dtype and sp > 1:
+        raise SystemExit(
+            "--grad-comm-dtype requires a pure data-parallel mesh "
+            "(--seq-parallel 1): a sequence axis would make the per-device "
+            "local forward see a partial example"
+        )
     step_fn = make_train_step(
-        model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip
+        model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip,
+        mesh=mesh if args.grad_comm_dtype else None,
+        grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_fn = make_eval_step(model, eval_kwargs={"train": False})
     batch_spec = P("data", "seq")

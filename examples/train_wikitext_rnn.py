@@ -76,6 +76,10 @@ def parse_args(argv=None):
     p.add_argument("--stat-decay", type=float, default=0.95)
     p.add_argument("--damping", type=float, default=0.003)
     p.add_argument("--kl-clip", type=float, default=0.001)
+    p.add_argument("--grad-comm-dtype", default=None, choices=[None, "bf16"],
+                   help="downcast the per-step data-parallel gradient mean "
+                        "on the wire (the reference's --fp16-allreduce on "
+                        "DistributedOptimizer); None = exact f32 reduction")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
@@ -145,7 +149,21 @@ def main(argv=None):
         # would desync the per-step collectives
         resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
 
-    train_step = make_lm_train_step(model, tx, kfac, grad_clip=args.clip)
+    if args.grad_comm_dtype:
+        from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+
+        comm_mesh = data_parallel_mesh()
+        if args.batch_size % comm_mesh.devices.size:
+            raise SystemExit(
+                f"--grad-comm-dtype shards the batch over {comm_mesh.devices.size} "
+                f"devices; --batch-size {args.batch_size} must divide evenly"
+            )
+    else:
+        comm_mesh = None
+    train_step = make_lm_train_step(
+        model, tx, kfac, grad_clip=args.clip, mesh=comm_mesh,
+        grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
+    )
     eval_step = make_lm_eval_step(model)
 
     writer = ScalarWriter(args.log_dir)

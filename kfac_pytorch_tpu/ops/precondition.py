@@ -271,11 +271,23 @@ def _apply_distributed(
     so the sum itself adds no error beyond the downcast rounding).
     """
     axes = tuple(mesh.axis_names)
+    diag_a = diag_a_names(singles)
     where = _stack_layout(
         {n: g.shape for n, g in grad_mats.items()},
         stacked,
-        diag_a_names(singles),
+        diag_a,
     )
+    # Emit updates in precondition_all's order (sorted diag-A first, then
+    # shape_groups order): dict insertion order feeds the KL-clip summation,
+    # so the distributed and replicated paths must reassociate identically
+    # for their results to match bitwise, not just to tolerance.
+    order = sorted(diag_a) + [
+        n
+        for names in shape_groups(
+            {n: g.shape for n, g in grad_mats.items() if n not in diag_a}
+        ).values()
+        for n in names
+    ]
 
     @partial(
         jax.shard_map,
@@ -289,7 +301,8 @@ def _apply_distributed(
         for a in axes[1:]:
             dev = dev * mesh.shape[a] + lax.axis_index(a)
         out: Dict[str, jnp.ndarray] = {}
-        for name, g in gmats.items():
+        for name in order:
+            g = gmats[name]
             loc = where[name]
 
             def _solve(name=name, g=g, loc=loc):

@@ -110,6 +110,7 @@ class KFAC:
         precond_precision: Optional[Any] = None,
         eigen_dtype: Any = jnp.float32,
         precond_method: str = "eigen",
+        track_diagnostics: bool = False,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -159,6 +160,20 @@ class KFAC:
                 "change when run at scale"
             )
         self.precond_comm_dtype = precond_comm_dtype
+        if distribute_precondition and (mesh is None or mesh.devices.size <= 1):
+            # update() silently takes the replicated path in this case (and
+            # precond_comm_dtype is then unused) — say so up front, mirroring
+            # the precond_comm_dtype-without-distribute refusal above. Not an
+            # error: trainers pass the same flags to 1-device dev runs.
+            print(
+                "WARNING: distribute_precondition=True has no effect without "
+                "a multi-device mesh — preconditioning runs replicated"
+                + (
+                    " and precond_comm_dtype is unused"
+                    if precond_comm_dtype is not None
+                    else ""
+                )
+            )
         self.mesh = mesh
         self.axis_name = axis_name
         self.eps = eps
@@ -202,6 +217,15 @@ class KFAC:
                 "block-diagonal approximation"
             )
         self.precond_method = precond_method
+        # Stability telemetry (costs two scalars of state + O(layers) mins):
+        # ν — the KL trust-region coefficient actually applied each step
+        # (kfac_preconditioner.py:320-326) — and the minimum damped
+        # eigenvalue of any layer's (G ⊗ A + λI). A preconditioner-driven
+        # divergence shows up here first: min eig → λ means a near-singular
+        # curvature direction is being amplified by ~1/λ, and ν ≈ 1 means
+        # the trust region is not catching it. Eigen method only (the
+        # inverse method never materializes eigenvalues).
+        self.track_diagnostics = track_diagnostics
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -305,12 +329,21 @@ class KFAC:
             singles, stacked = precond_ops.split_inv_state(eigen)
         else:
             singles, stacked = precond_ops.split_eigen_state(eigen)
-        return {
+        state = {
             "step": jnp.zeros((), jnp.int32),
             "factors": facs,
             "eigen": singles,
             "eigen_stacked": stacked,
         }
+        if self.track_diagnostics:
+            # fixed from init so the state pytree structure never changes
+            # (a mid-run structure flip would retrace the jitted step and
+            # break checkpoint/donation contracts)
+            state["diagnostics"] = {
+                "nu": jnp.ones((), jnp.float32),
+                "min_damped_eig": jnp.zeros((), jnp.float32),
+            }
+        return state
 
     # ------------------------------------------------------------------
     # Update
@@ -504,4 +537,27 @@ class KFAC:
             "eigen": eigen,
             "eigen_stacked": stacked,
         }
+        if self.track_diagnostics:
+            min_eig = state["diagnostics"]["min_damped_eig"]
+            if update_eigen and self.precond_method == "eigen":
+                # λmin(G ⊗ A + λI) = min(dG)·min(dA) + λ (Kronecker
+                # eigenvalues are products; the stored dA/dG are already
+                # floored ≥ 0 by the eps floor in the eigh path)
+                mins = []
+                for e in list(eigen.values()) + list((stacked or {}).values()):
+                    if "dA" in e and "dG" in e:
+                        # axis=-1 keeps the reduction per-layer for stacked
+                        # [k, n] groups (min over rows of each layer's own
+                        # product, not a cross-layer pairing)
+                        mins.append(
+                            jnp.min(
+                                jnp.min(e["dG"].astype(jnp.float32), axis=-1)
+                                * jnp.min(e["dA"].astype(jnp.float32), axis=-1)
+                            )
+                        )
+                if mins:
+                    min_eig = jnp.min(jnp.stack(mins)) + jnp.asarray(
+                        damping, jnp.float32
+                    )
+            new_state["diagnostics"] = {"nu": nu, "min_damped_eig": min_eig}
         return new_grads, new_state

@@ -214,7 +214,17 @@ def load_torch_checkpoint(path: str, arch: str):
     """
     import torch
 
-    obj = torch.load(path, map_location="cpu", weights_only=True)
+    import inspect
+
+    if "weights_only" in inspect.signature(torch.load).parameters:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+    else:
+        # torch < 1.13 has no weights_only kwarg (the reference's validated
+        # stack is torch 1.1, README.md:17) — its checkpoints are plain
+        # tensor dicts, so the unrestricted load is equivalent there. Gate on
+        # the signature, NOT a try/except TypeError: on modern torch the
+        # restricted load must never silently fall back to full unpickling.
+        obj = torch.load(path, map_location="cpu")
     sd = obj.get("model", obj) if isinstance(obj, dict) else obj
     sd = {k: v.numpy() if hasattr(v, "numpy") else v for k, v in sd.items()}
     # the CIFAR zoo heads with `linear`, the ImageNet zoo with `fc`

@@ -130,8 +130,9 @@ def synthetic_cifar_like(
     n_test: int = 10_000,
     num_classes: int = 10,
     size: int = 32,
-    prototypes_per_class: int = 4,
-    noise: float = 0.35,
+    prototypes_per_class: int = 10,
+    noise: float = 0.55,
+    label_noise: float = 0.08,
     seed: int = 0,
 ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
     """Deterministic, genuinely LEARNABLE CIFAR-shaped dataset.
@@ -146,6 +147,13 @@ def synthetic_cifar_like(
     translations make it non-linearly-separable (a template matcher fails on
     shifts), so optimizers genuinely have to fit conv features — while the
     generator stays a few lines of seeded numpy, reproducible anywhere.
+
+    Sized to NOT saturate: the round-3 defaults (4 prototypes, 0.35 noise)
+    hit 100% val accuracy by epoch ~13, making the back half of a 20-epoch
+    optimizer comparison vacuous (round-3 verdict). 10 prototypes/class +
+    0.55 pixel noise keep ResNet-32 below ceiling across a full run, and
+    ``label_noise`` flips that fraction of TRAIN labels uniformly (val stays
+    clean), bounding train accuracy so late-epoch curves still discriminate.
     Returns ``((x_train, y_train), (x_test, y_test))`` with normalized f32
     NHWC images, the same interface as :func:`load_cifar10`.
     """
@@ -163,7 +171,7 @@ def synthetic_cifar_like(
             img = (img + np.roll(img, 1, 1) + np.roll(img, -1, 1)) / 3.0
             protos[c, p] = img
 
-    def make_split(n, split_seed):
+    def make_split(n, split_seed, flip_labels=0.0):
         r = np.random.RandomState(split_seed)
         y = r.randint(0, num_classes, size=n).astype(np.int32)
         pick = r.randint(0, prototypes_per_class, size=n)
@@ -180,9 +188,20 @@ def synthetic_cifar_like(
                 img = img[:, ::-1]
             x[i] = img * contrast[i] + bright[i]
         x += r.randn(n, size, size, 3).astype(np.float32) * noise
+        if flip_labels > 0.0:
+            # uniform wrong-label flips AFTER the images are built, so the
+            # pixels still show the true class — irreducible training error
+            hit = r.rand(n) < flip_labels
+            y = y.copy()
+            y[hit] = (
+                y[hit] + r.randint(1, num_classes, size=int(hit.sum()))
+            ) % num_classes
         return x, y
 
-    return make_split(n_train, seed + 1), make_split(n_test, seed + 2)
+    return (
+        make_split(n_train, seed + 1, flip_labels=label_noise),
+        make_split(n_test, seed + 2),
+    )
 
 
 def synthetic_batches(

@@ -69,4 +69,9 @@ def run_imagenet_validation(
         vl_sum += float(m["loss_sum"])
         vc_sum += float(m["correct"])
         vn += float(m["count"])
+    if vn == 0:
+        raise ValueError(
+            "no validation examples found (empty val split) — check the "
+            "--data-dir layout / --val-split arguments"
+        )
     return vl_sum / vn, vc_sum / vn

@@ -34,31 +34,31 @@ def make_lm_train_step(
     tx: optax.GradientTransformation,
     kfac: Optional[KFAC] = None,
     grad_clip: float = 0.25,
+    mesh=None,
+    grad_comm_dtype=None,
 ):
     """Build the jitted LM train step.
 
     ``step_fn(state, batch, carry, dropout_rng, lr, damping,
     update_factors=..., update_eigen=...)`` → ``(state, new_carry, metrics)``.
     ``carry`` is the recurrent state threaded across bptt segments.
+
+    ``grad_comm_dtype`` (e.g. ``jnp.bfloat16``, requires ``mesh``): compress
+    the data-parallel gradient mean on the wire — the LM twin of
+    ``training.step._compressed_grads`` (the reference's ``--fp16-allreduce``,
+    pytorch_wikitext_rnn.py's DistributedOptimizer compression). The
+    recurrent carry shards over the batch axis (every cell carry leaf is
+    batch-leading) and stays per-device; dropout keys fold in the device
+    index so masks are iid across the mesh.
     """
+    if grad_comm_dtype is not None and mesh is None:
+        raise ValueError(
+            "grad_comm_dtype compresses the data-parallel gradient mean and "
+            "needs mesh= to know the reduction axis"
+        )
 
-    def train_step(
-        state: TrainState,
-        batch: Tuple[jnp.ndarray, jnp.ndarray],
-        carry,
-        dropout_rng,
-        lr,
-        damping,
-        *,
-        update_factors: bool = False,
-        update_eigen: bool = False,
-        diag_warmup_done: bool = True,
-    ):
-        tokens, targets = batch  # [B, T] each
-        carry = jax.lax.stop_gradient(carry)  # truncate BPTT at segment edge
+    def _compute(params, tokens, targets, carry, dropout_rng, capture_stats):
         rngs = {"dropout": dropout_rng}
-        capture_stats = kfac is not None and update_factors
-
         if capture_stats:
             perts = capture.perturbation_zeros(model, tokens, train=True)
 
@@ -78,7 +78,7 @@ def make_lm_train_step(
 
             (loss, (mut, new_carry)), (grads, gperts) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True
-            )(state.params, perts)
+            )(params, perts)
             names = (
                 kfac.layers
                 if kfac.layers is not None
@@ -98,9 +98,70 @@ def make_lm_train_step(
                 return loss, new_carry
 
             (loss, new_carry), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
+                params
             )
             a_c = g_s = None
+        return loss, grads, a_c, g_s, new_carry
+
+    def _compute_compressed(params, tokens, targets, carry, dropout_rng,
+                            capture_stats):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from kfac_pytorch_tpu.training.step import (
+            pmean_compressed,
+            require_pure_dp_mesh,
+        )
+
+        axis = require_pure_dp_mesh(mesh)
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P(), P(), P(), P(axis)),
+            check_vma=False,
+        )
+        def _inner(params, tokens, targets, carry, rng):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            loss, grads, a_c, g_s, new_carry = _compute(
+                params, tokens, targets, carry, rng, capture_stats
+            )
+            grads = pmean_compressed(grads, axis, grad_comm_dtype)
+            loss = jax.lax.pmean(loss, axis)
+            if a_c is not None:
+                a_c = jax.lax.pmean(a_c, axis)
+            if g_s is not None:
+                g_s = jax.lax.pmean(g_s, axis)
+            return loss, grads, a_c, g_s, new_carry
+
+        return _inner(params, tokens, targets, carry, dropout_rng)
+
+    def train_step(
+        state: TrainState,
+        batch: Tuple[jnp.ndarray, jnp.ndarray],
+        carry,
+        dropout_rng,
+        lr,
+        damping,
+        *,
+        update_factors: bool = False,
+        update_eigen: bool = False,
+        diag_warmup_done: bool = True,
+    ):
+        tokens, targets = batch  # [B, T] each
+        carry = jax.lax.stop_gradient(carry)  # truncate BPTT at segment edge
+        capture_stats = kfac is not None and update_factors
+
+        compute = (
+            _compute_compressed
+            if grad_comm_dtype is not None and mesh.devices.size > 1
+            else _compute
+        )
+        loss, grads, a_c, g_s, new_carry = compute(
+            state.params, tokens, targets, carry, dropout_rng, capture_stats
+        )
 
         if grad_clip:
             grads = _clip_by_global_norm(grads, grad_clip)
@@ -124,6 +185,11 @@ def make_lm_train_step(
         params = optax.apply_updates(state.params, updates)
 
         metrics = {"loss": loss, "ppl": jnp.exp(loss)}
+        if kfac is not None and kfac.track_diagnostics:
+            metrics["kfac_nu"] = kfac_state["diagnostics"]["nu"]
+            metrics["kfac_min_damped_eig"] = kfac_state["diagnostics"][
+                "min_damped_eig"
+            ]
         new_state = TrainState(
             step=state.step + 1,
             params=params,

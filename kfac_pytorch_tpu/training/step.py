@@ -31,6 +31,80 @@ from kfac_pytorch_tpu.preconditioner import KFAC
 PyTree = Any
 
 
+def require_pure_dp_mesh(mesh) -> str:
+    """The compressed-grad wrappers need every device to see whole examples:
+    returns the batch axis name, rejecting meshes with a real second axis."""
+    if any(mesh.shape[a] > 1 for a in mesh.axis_names[1:]):
+        raise ValueError(
+            "grad_comm_dtype requires a pure data-parallel mesh (non-data "
+            f"axes of size 1); got {dict(mesh.shape)} — a sequence/model "
+            "axis would make the per-device local forward see a partial "
+            "example"
+        )
+    return mesh.axis_names[0]
+
+
+def pmean_compressed(tree: PyTree, axis: str, comm_dtype) -> PyTree:
+    """Cross-device mean with the wire payload downcast to ``comm_dtype``
+    (each device's partial value rounds once; the mean itself is exact in
+    the psum's accumulation) and the result restored to f32."""
+    return jax.tree_util.tree_map(
+        lambda g: lax.pmean(g.astype(comm_dtype), axis).astype(jnp.float32),
+        tree,
+    )
+
+
+def _compressed_grads(compute, mesh, comm_dtype, accum_steps):
+    """Wrap a loss-and-grads computation so the DP gradient mean crosses the
+    wire in ``comm_dtype`` — the reference's ``--fp16-allreduce`` Horovod
+    compression (pytorch_cifar10_resnet.py:190-195), TPU-native.
+
+    Under plain GSPMD the grad reduction is implicit (XLA inserts an f32
+    psum over the sharded batch axis), so there is no tensor to cast. This
+    wrapper makes the reduction explicit: a ``shard_map`` over the (single)
+    mesh axis computes per-device grads from the LOCAL microbatch, casts
+    them to ``comm_dtype``, and one ``pmean`` reassembles — only the
+    downcast values travel. Loss/accuracy and any K-FAC factor statistics
+    pmean alongside in f32 (the reference never compresses its factor
+    allreduce either — only ``DistributedOptimizer``'s grad one). Exact up
+    to the downcast rounding of each device's partial gradient.
+
+    Semantics note, same as the reference: BatchNorm inside the wrapper
+    normalizes over the LOCAL per-device batch (each Horovod rank's torch BN
+    sees only its own batch too), where the GSPMD path's global-batch mean
+    acts like sync-BN; running stats are pmean'd so state stays replicated.
+    """
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    axis = require_pure_dp_mesh(mesh)
+    bspec = P(None, axis) if accum_steps > 1 else P(axis)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), bspec, bspec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def _inner(params, batch_stats, images, labels):
+        loss, acc, grads, new_bs, a_c, g_s = compute(
+            params, batch_stats, images, labels
+        )
+        grads = pmean_compressed(grads, axis, comm_dtype)
+        loss, acc = lax.pmean(loss, axis), lax.pmean(acc, axis)
+        if new_bs:
+            new_bs = lax.pmean(new_bs, axis)
+        if a_c is not None:
+            a_c = lax.pmean(a_c, axis)
+        if g_s is not None:
+            g_s = lax.pmean(g_s, axis)
+        return loss, acc, grads, new_bs, a_c, g_s
+
+    return _inner
+
+
 @flax.struct.dataclass
 class TrainState:
     """Full training state pytree (checkpointable, incl. K-FAC curvature)."""
@@ -101,8 +175,15 @@ def make_train_step(
     accum_steps: int = 1,
     grad_clip: float = 0.0,
     stats_all_microbatches: bool = False,
+    mesh=None,
+    grad_comm_dtype=None,
 ):
     """Build the jitted train step.
+
+    ``grad_comm_dtype`` (e.g. ``jnp.bfloat16``, requires ``mesh``) compresses
+    the data-parallel gradient mean on the wire — see
+    :func:`_compressed_grads`. ``None`` (default) leaves the reduction to
+    GSPMD at f32.
 
     Returns ``step_fn(state, batch, lr, damping, update_factors=...,
     update_eigen=...)`` → ``(state, metrics)``. ``lr``/``damping`` are traced
@@ -132,6 +213,12 @@ def make_train_step(
       running the capture path in the scan body.
     """
     train_kwargs = dict(train_kwargs or {})
+    if grad_comm_dtype is not None and mesh is None:
+        raise ValueError(
+            "grad_comm_dtype compresses the data-parallel gradient mean and "
+            "needs mesh= to know the reduction axis — refusing a config "
+            "whose numerics would silently change when run at scale"
+        )
 
     def loss_and_grads_captured(params, batch_stats, images, labels):
         perts = capture.perturbation_zeros(model, images, **train_kwargs)
@@ -195,7 +282,7 @@ def make_train_step(
         acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
         return loss, acc, grads, new_bs, None, None
 
-    def accum_loss_and_grads(state, images, labels, capture_stats):
+    def accum_loss_and_grads(params, batch_stats, images, labels, capture_stats):
         # images/labels: [accum_steps, microbatch, ...]; BN stats thread
         # sequentially through microbatches like the reference's sub-batch
         # forwards; the tail microbatch runs the capture path when needed.
@@ -205,14 +292,14 @@ def make_train_step(
             bs, gsum, lsum, asum = carry
             im, lb = xs
             loss, acc, grads, new_bs, _, _ = loss_and_grads_plain(
-                state.params, bs, im, lb
+                params, bs, im, lb
             )
             gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
             return (new_bs, gsum, lsum + loss, asum + acc), None
 
         carry = (
-            state.batch_stats,
-            jax.tree_util.tree_map(jnp.zeros_like, state.params),
+            batch_stats,
+            jax.tree_util.tree_map(jnp.zeros_like, params),
             jnp.float32(0.0),
             jnp.float32(0.0),
         )
@@ -222,7 +309,7 @@ def make_train_step(
         a_c = g_s = None
         if capture_stats:
             loss, acc, grads, bs, a_c, g_s = loss_and_grads_captured(
-                state.params, bs, images[-1], labels[-1]
+                params, bs, images[-1], labels[-1]
             )
             gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
             lsum, asum = lsum + loss, asum + acc
@@ -230,13 +317,13 @@ def make_train_step(
         grads = jax.tree_util.tree_map(lambda g: g * inv, gsum)
         return lsum * inv, asum * inv, grads, bs, a_c, g_s
 
-    def accum_loss_and_grads_all_stats(state, images, labels):
+    def accum_loss_and_grads_all_stats(params, batch_stats, images, labels):
         # stats_all_microbatches path: capture runs in EVERY scan iteration
         # and the per-microbatch factor statistics are averaged (== the
         # full-effective-batch statistics; see make_train_step docstring).
         stat_shapes = jax.eval_shape(
             loss_and_grads_captured,
-            state.params, state.batch_stats, images[0], labels[0],
+            params, batch_stats, images[0], labels[0],
         )
         zeros_like_shape = lambda tree: jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), tree
@@ -246,7 +333,7 @@ def make_train_step(
             bs, gsum, lsum, asum, a_sum, g_sum = carry
             im, lb = xs
             loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_captured(
-                state.params, bs, im, lb
+                params, bs, im, lb
             )
             gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
             a_sum = jax.tree_util.tree_map(jnp.add, a_sum, a_c)
@@ -254,8 +341,8 @@ def make_train_step(
             return (new_bs, gsum, lsum + loss, asum + acc, a_sum, g_sum), None
 
         carry = (
-            state.batch_stats,
-            jax.tree_util.tree_map(jnp.zeros_like, state.params),
+            batch_stats,
+            jax.tree_util.tree_map(jnp.zeros_like, params),
             jnp.float32(0.0),
             jnp.float32(0.0),
             zeros_like_shape(stat_shapes[4]),
@@ -282,20 +369,28 @@ def make_train_step(
     ):
         images, labels = batch
         capture_stats = kfac is not None and update_factors
-        if accum_steps > 1 and capture_stats and stats_all_microbatches:
-            loss, acc, grads, new_bs, a_c, g_s = accum_loss_and_grads_all_stats(
-                state, images, labels
-            )
-        elif accum_steps > 1:
-            loss, acc, grads, new_bs, a_c, g_s = accum_loss_and_grads(
-                state, images, labels, capture_stats
-            )
-        elif capture_stats:
-            loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_captured(
-                state.params, state.batch_stats, images, labels
-            )
+
+        def _compute(params, batch_stats, images, labels):
+            if accum_steps > 1 and capture_stats and stats_all_microbatches:
+                return accum_loss_and_grads_all_stats(
+                    params, batch_stats, images, labels
+                )
+            elif accum_steps > 1:
+                return accum_loss_and_grads(
+                    params, batch_stats, images, labels, capture_stats
+                )
+            elif capture_stats:
+                return loss_and_grads_captured(
+                    params, batch_stats, images, labels
+                )
+            return loss_and_grads_plain(params, batch_stats, images, labels)
+
+        if grad_comm_dtype is not None and mesh is not None and mesh.devices.size > 1:
+            loss, acc, grads, new_bs, a_c, g_s = _compressed_grads(
+                _compute, mesh, grad_comm_dtype, accum_steps
+            )(state.params, state.batch_stats, images, labels)
         else:
-            loss, acc, grads, new_bs, a_c, g_s = loss_and_grads_plain(
+            loss, acc, grads, new_bs, a_c, g_s = _compute(
                 state.params, state.batch_stats, images, labels
             )
 
@@ -323,6 +418,11 @@ def make_train_step(
         params = optax.apply_updates(state.params, updates)
 
         metrics = {"loss": loss, "accuracy": acc}
+        if kfac is not None and kfac.track_diagnostics:
+            metrics["kfac_nu"] = kfac_state["diagnostics"]["nu"]
+            metrics["kfac_min_damped_eig"] = kfac_state["diagnostics"][
+                "min_damped_eig"
+            ]
         new_state = TrainState(
             step=state.step + 1,
             params=params,

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 CIFAR convergence curves (VERDICT r3 #3): the HARDENED synthetic
+# task (10 prototypes/class, 0.55 pixel noise, 8% train label noise — no
+# 100%-accuracy saturation) with K-FAC stability telemetry on. Same recipe
+# as the r3 curves (4-device data-parallel mesh = the reference's 4-V100
+# CIFAR job: global batch 512, peak lr 0.4, 5-epoch warmup, decay 13/17).
+set -u
+cd /root/repo
+export KFAC_FORCE_PLATFORM=cpu:4
+LOG=/tmp/cifar_curves_r4.log
+run() {
+  name=$1; shift
+  if [ -f "logs/$name/scalars.jsonl" ]; then
+    echo "[skip] $name (exists)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  echo "[$(date +%H:%M:%S)] done $name rc=$?" >> "$LOG"
+}
+
+CIFAR="python examples/train_cifar10_resnet.py --model resnet32 --epochs 20 --lr-decay 13 17 --seed 42"
+
+run cifar10_resnet32_kfac_r4 $CIFAR \
+  --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+  --precond-precision default --eigen-dtype bf16 --kfac-diagnostics
+run cifar10_resnet32_sgd_r4 $CIFAR --kfac-update-freq 0
+
+echo "[$(date +%H:%M:%S)] curves done" >> "$LOG"

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 LM evidence sweep (VERDICT r3 #4): serialized CPU runs on the
+# 1-core box. Goal: either a config where K-FAC beats the SGD twin per-epoch
+# on the LSTM (hypothesis: the r3 loss came from the KL clip overclamping at
+# the reference's raw-SGD lr=20 — nu ~ 1/lr), or the honest negative result;
+# plus the missing transformer SGD twin.
+#
+# Fresh twins for EVERYTHING (same data/seed/epochs) so no pair mixes r3 and
+# r4 configurations.
+set -u
+cd /root/repo
+# ONE virtual device: an 8-device mesh on a 1-core box multiplies the
+# transformer's global batch (and total FLOPs) 8x for zero extra insight —
+# the multi-device paths are covered by the pytest mesh suite.
+export KFAC_FORCE_PLATFORM=cpu:1
+LOG=/tmp/lm_sweep_r4.log
+run() {
+  name=$1; shift
+  if [ -f "logs/$name/scalars.jsonl" ]; then
+    echo "[skip] $name (exists)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  echo "[$(date +%H:%M:%S)] done $name rc=$?" >> "$LOG"
+}
+
+LSTM="python examples/train_wikitext_rnn.py --synthetic --epochs 6 --emsize 256 --nhid 256 --seed 42"
+
+# reference-recipe SGD twin (lr 20 is the reference wikitext default)
+run wikitext_lstm_sgd_r4 $LSTM --kfac-update-freq 0
+# lr-control: does plain SGD prefer the K-FAC arm's lr? (it should not —
+# otherwise a K-FAC "win" below would just be an lr effect)
+run wikitext_lstm_sgd_lr5_r4 $LSTM --kfac-update-freq 0 --base-lr 5
+# r3-parity K-FAC (the loser): lr 20, kl-clip 0.001 — kept for the record
+run wikitext_lstm_kfac_parity_r4 $LSTM --kfac-update-freq 10
+# tuned K-FAC: per-optimizer lr + a trust region that admits the
+# preconditioned step (nu = sqrt(kl_clip)/lr at the clip boundary)
+run wikitext_lstm_kfac_tuned_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01
+# tuned + embedding preconditioning (beyond-reference lever)
+run wikitext_lstm_kfac_emb_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01 --kfac-embedding
+
+TRANS="python examples/train_transformer_lm.py --synthetic --epochs 4 --d-model 256 --n-layers 2 --seq-len 128 --batch-size 16 --seed 42"
+run transformer_lm_kfac_r4 $TRANS --kfac-update-freq 10
+run transformer_lm_sgd_r4 $TRANS --kfac-update-freq 0
+
+echo "[$(date +%H:%M:%S)] sweep done" >> "$LOG"

@@ -1,0 +1,105 @@
+"""distribute_precondition scaling trend on the virtual CPU mesh.
+
+VERDICT r3 #2 asked for the 8-device scaling trend to ground the pod-scale
+claim. On this box all virtual devices share ONE physical core, so
+wall-clock cannot show the speedup (8 devices' work serializes onto the same
+core; total CPU time is constant plus psum overhead). What CAN be measured
+honestly here:
+
+* per-device FLOPs of the compiled SPMD program (XLA cost analysis) — the
+  quantity that divides by world at fixed total work, and exactly what a
+  real pod's per-chip step time follows;
+* the exchanged collective bytes (the psum payload the wire carries);
+* wall-clock, reported with the 1-core caveat for completeness.
+
+Usage: KFAC_FORCE_PLATFORM ignored — forces its own CPU mesh.
+Writes one JSON line per world size.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from kfac_pytorch_tpu.platform_override import force_cpu_devices
+
+assert force_cpu_devices(8), "backend already initialized"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from kfac_pytorch_tpu.ops import precondition as pc
+from kfac_pytorch_tpu.parallel.assignment import precondition_assignment
+
+# ResNet-50 (g=out, a=in) factor-space shapes (same table as bench_precond)
+shapes = []
+shapes.append((64, 148))
+shapes += [(64, 64), (64, 576), (256, 64), (256, 64)]
+shapes += [(64, 256), (64, 576), (256, 64)] * 2
+shapes += [(128, 256), (128, 1152), (512, 128), (512, 256)]
+shapes += [(128, 512), (128, 1152), (512, 128)] * 3
+shapes += [(256, 512), (256, 2304), (1024, 256), (1024, 512)]
+shapes += [(256, 1024), (256, 2304), (1024, 256)] * 5
+shapes += [(512, 1024), (512, 4608), (2048, 512), (2048, 1024)]
+shapes += [(512, 2048), (512, 4608), (2048, 512)] * 2
+shapes.append((1001, 2049))
+
+rng = np.random.RandomState(0)
+gmats, eigen = {}, {}
+for i, (g, a) in enumerate(shapes):
+    n = f"l{i}"
+    gmats[n] = jnp.asarray(rng.randn(g, a).astype(np.float32) * 0.01)
+    qa, _ = np.linalg.qr(rng.randn(a, a).astype(np.float32))
+    qg, _ = np.linalg.qr(rng.randn(g, g).astype(np.float32))
+    eigen[n] = {
+        "QA": jnp.asarray(qa), "QG": jnp.asarray(qg),
+        "dA": jnp.asarray(np.abs(rng.randn(a)).astype(np.float32)),
+        "dG": jnp.asarray(np.abs(rng.randn(g)).astype(np.float32)),
+    }
+damping = jnp.float32(1e-3)
+singles, stacked = pc.split_eigen_state(eigen)
+gshapes = {n: tuple(g.shape) for n, g in gmats.items()}
+
+
+def measure(world):
+    devs = jax.devices()[:world]
+    mesh = Mesh(np.asarray(devs), ("data",))
+    if world == 1:
+        fn = jax.jit(lambda gm: pc.precondition_all(
+            gm, singles, damping, stacked=stacked))
+    else:
+        owners = precondition_assignment(gshapes, world)
+        fn = jax.jit(lambda gm: pc.precondition_all_distributed(
+            gm, singles, damping, stacked=stacked, mesh=mesh, owners=owners))
+    compiled = fn.lower(gmats).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops = float(cost.get("flops", float("nan")))
+    out = compiled(gmats)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = compiled(gmats)
+    jax.block_until_ready(out)
+    wall = (time.perf_counter() - t0) / 5 * 1e3
+    comm_bytes = sum(
+        int(np.prod(s)) * 4 for s in gshapes.values()) if world > 1 else 0
+    rec = {
+        "world": world,
+        "per_device_gflops": round(flops / 1e9, 3),
+        "psum_payload_mb": round(comm_bytes / 1e6, 2),
+        "wall_ms_1core_caveat": round(wall, 2),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+if __name__ == "__main__":
+    recs = [measure(w) for w in (1, 2, 4, 8)]
+    base = recs[0]["per_device_gflops"]
+    for r in recs:
+        r["flops_vs_world1"] = round(r["per_device_gflops"] / base, 4)
+    print(json.dumps({"trend": recs}), flush=True)

@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-4 TPU queue: the stranded on-chip work, in VERDICT r3 priority order.
+# Discipline (see .claude/skills/verify/SKILL.md): ONE TPU process at a time,
+# NEVER kill a live TPU client (wedges the lease 10-30 min), wait for the
+# backend between phases instead of cascading failures.
+#
+# Priority: (1) flash-attention Mosaic hardware tests, (2) bench.py full arm
+# matrix -> the first trustworthy overhead number, (3) precondition
+# micro-bench, (4) short real-TPU CIFAR K-FAC convergence vs SGD.
+set -u
+cd /root/repo
+STATUS=/tmp/tpu_queue_v3.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+wait_backend() {
+  # Probe until jax.devices() works. Each probe is its own process under
+  # `timeout`: when the relay is dead, clients sometimes HANG in recvmsg
+  # instead of raising, and killing a client of a DEAD backend cannot wedge
+  # a lease — there is none.
+  for i in $(seq 1 40); do
+    if timeout 120 python -c "import jax; print(jax.devices()[0])"; then
+      return 0
+    fi
+    echo "backend probe $i failed; sleeping 30s" >&2
+    sleep 30
+  done
+  return 1
+}
+
+run_phase() {
+  # run_phase <name> <logfile> <cmd...>; retries twice, waiting for the
+  # backend before each attempt; marks success in $STATUS.
+  name=$1; logf=$2; shift 2
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
+    log "$name already done, skip"; return 0
+  fi
+  for attempt in 1 2 3; do
+    log "$name attempt $attempt: waiting for backend"
+    if ! wait_backend 2>> "$logf"; then
+      log "$name attempt $attempt: backend never came back"; continue
+    fi
+    log "$name attempt $attempt: start"
+    "$@" >> "$logf" 2>&1
+    rc=$?
+    log "$name attempt $attempt: rc=$rc"
+    if [ $rc -eq 0 ]; then echo "DONE $name" >> "$STATUS"; return 0; fi
+    sleep 120
+  done
+  return 1
+}
+
+log "queue v3 start"
+
+run_phase flash-hw /tmp/flash_hw.log \
+  env KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware
+
+# The watchdogged bench: always leaves parseable JSON in /tmp/bench_r4.json
+# even if the tunnel dies mid-run (partial lines stream per arm).
+run_phase bench /tmp/bench_r4.log \
+  sh -c 'python bench.py > /tmp/bench_r4.json 2>> /tmp/bench_r4.log'
+
+run_phase bench_precond /tmp/bench_precond.out \
+  python scratch/bench_precond.py
+
+# Short real-TPU convergence check: the hardened synthetic task, K-FAC vs
+# SGD twins, identical flags (epochs kept short; the full-length curves run
+# on CPU where wall-clock is the only cost).
+run_phase cifar-kfac-tpu /tmp/cifar_kfac_tpu.log \
+  python examples/train_cifar10_resnet.py \
+    --model resnet32 --epochs 12 --lr-decay 8 11 \
+    --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+    --precond-precision default --eigen-dtype bf16 \
+    --log-dir logs/cifar10_resnet32_kfac_tpu --checkpoint-dir /tmp/cc_kfac_tpu
+
+run_phase cifar-sgd-tpu /tmp/cifar_sgd_tpu.log \
+  python examples/train_cifar10_resnet.py \
+    --model resnet32 --epochs 12 --lr-decay 8 11 \
+    --kfac-update-freq 0 \
+    --log-dir logs/cifar10_resnet32_sgd_tpu --checkpoint-dir /tmp/cc_sgd_tpu
+
+log "queue v3 done"

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Run the test suite as ONE PYTEST PROCESS PER FILE.
+#
+# Why: in a single process, jit-compiled programs (and their XLA executables)
+# accumulate across all ~160 tests — on a small box the suite climbs past
+# ~20 GB RSS and the kernel kills it on the last file, even though every file
+# passes standalone (round-3 verdict, Weak #8). Per-file shards bound the
+# cache lifetime to one file; total wall time is essentially unchanged
+# because compile time dominates either way.
+#
+# Usage: scripts/run_tests_sharded.sh [logfile]
+#   exit 0 iff every file's shard passed (pytest rc 0 or 5=no tests).
+#   Full per-file pytest output goes to the logfile; a one-line-per-file
+#   summary plus the final tally goes to stdout.
+set -u
+cd "$(dirname "$0")/.."
+out="${1:-/tmp/pytest_sharded.log}"
+: > "$out"
+declare -i nfail=0 npass=0
+tmp=$(mktemp)
+trap 'rm -f "$tmp"' EXIT
+for f in tests/test_*.py; do
+  # per-file temp log: the summary line must come from THIS file's shard —
+  # grepping the shared log would attribute the previous file's tally to a
+  # shard that died before printing one (e.g. OOM-killed)
+  python -m pytest "$f" -q > "$tmp" 2>&1
+  rc=$?
+  { echo "=== $f ==="; cat "$tmp"; } >> "$out"
+  tail_line=$(grep -E "passed|failed|error|skipped" "$tmp" | tail -1)
+  if [ $rc -eq 0 ] || [ $rc -eq 5 ]; then
+    npass+=1; echo "PASS $f: $tail_line"
+  else
+    nfail+=1; echo "FAIL $f (rc=$rc): ${tail_line:-no pytest summary (killed?)}"
+  fi
+done
+echo "---"
+echo "files: $((npass+nfail)), failed: $nfail (full log: $out)"
+exit $((nfail > 0))

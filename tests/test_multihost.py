@@ -98,6 +98,47 @@ out["losses"] = losses
 out["param_sum"] = float(jax.device_get(
     jax.tree_util.tree_reduce(lambda a, b: a + jnp.sum(b), st.params, jnp.float32(0))
 ))
+
+# round-3/4 features on a REAL 2-process world (round-3 verdict, Weak #6):
+# embedding K-FAC (diagonal-A), owner-sharded every-step preconditioning
+# with bf16 wire compression, and the bf16 data-parallel grad-mean
+# compression — all in one step program.
+from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.models.layers import KFACEmbed
+
+class M2(nn.Module):
+    @nn.compact
+    def __call__(self, toks, train=True):
+        x = KFACEmbed(12, 8, name="emb")(toks)
+        x = x.mean(axis=1)
+        return KFACDense(4, name="head")(jax.nn.relu(KFACDense(8, name="fc")(x)))
+
+model2 = M2()
+T = rng.randint(0, 12, size=(4, 5)).astype(np.int32)
+Y2 = rng.randint(0, 4, size=4).astype(np.int32)
+toks0 = jnp.asarray(T)
+variables2 = model2.init(jax.random.PRNGKey(1), toks0)
+params2 = variables2["params"]
+kfac2 = KFAC(
+    damping=0.003, mesh=mesh,
+    layers=capture.discover_layers(model2, toks0),
+    distribute_precondition=True, precond_comm_dtype=jnp.bfloat16,
+)
+st2 = TrainState(step=jnp.zeros((), jnp.int32), params=params2, batch_stats={},
+                 opt_state=tx.init(params2), kfac_state=kfac2.init(params2))
+st2 = jax.device_put(st2, NamedSharding(mesh, P()))
+batch2 = put_global_batch(mesh, (T[pid * 2:(pid + 1) * 2], Y2[pid * 2:(pid + 1) * 2]))
+fn2 = make_train_step(model2, tx, kfac2, train_kwargs={"train": True},
+                      mesh=mesh, grad_comm_dtype=jnp.bfloat16)
+losses2 = []
+for i in range(3):
+    st2, m2 = fn2(st2, batch2, jnp.float32(0.1), jnp.float32(0.003),
+                  update_factors=True, update_eigen=(i == 0))
+    losses2.append(float(jax.device_get(m2["loss"])))
+out["losses2"] = losses2
+out["param_sum2"] = float(jax.device_get(
+    jax.tree_util.tree_reduce(lambda a, b: a + jnp.sum(b), st2.params, jnp.float32(0))
+))
 print("RESULT " + json.dumps(out), flush=True)
 """
 
@@ -153,3 +194,8 @@ def test_two_process_distributed_world(tmp_path):
     assert r0["losses"] == r1["losses"]
     assert r0["losses"][2] < r0["losses"][0]
     assert r0["param_sum"] == r1["param_sum"]
+    # embedding K-FAC + distribute_precondition(bf16) + bf16 grad comm:
+    # still SPMD-agreeing across processes, still training
+    assert r0["losses2"] == r1["losses2"]
+    assert r0["losses2"][2] < r0["losses2"][0]
+    assert r0["param_sum2"] == r1["param_sum2"]

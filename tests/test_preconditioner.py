@@ -446,3 +446,44 @@ def test_distributed_precondition_conv_model():
         np.testing.assert_allclose(np.asarray(g_rep[n]["kernel"]),
                                    np.asarray(g_d[n]["kernel"]),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_track_diagnostics():
+    """track_diagnostics=True: nu is the applied KL-clip coefficient and
+    min_damped_eig = min over layers of min(dG)*min(dA) + damping, refreshed
+    only on eigen updates (carried through plain steps)."""
+    from kfac_pytorch_tpu.ops import factors as F
+
+    rng = np.random.RandomState(3)
+    params = {"fc": {"kernel": jnp.asarray(rng.randn(5, 4).astype(np.float32))}}
+    acts = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+    gout = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    a_c = {"fc": F.compute_a_dense(acts, has_bias=False)}
+    g_s = {"fc": F.compute_g_dense(gout, batch_averaged=True)}
+    grads = {"fc": {"kernel": jnp.asarray(rng.randn(5, 4).astype(np.float32))}}
+
+    kfac = KFAC(damping=0.01, track_diagnostics=True)
+    state = kfac.init(params)
+    assert float(state["diagnostics"]["nu"]) == 1.0
+    _, state = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s, lr=0.1,
+        damping=0.01, update_factors=True, update_eigen=True,
+    )
+    d = state["diagnostics"]
+    nu, me = float(d["nu"]), float(d["min_damped_eig"])
+    assert 0.0 < nu <= 1.0
+    assert me >= 0.01  # floored eigenvalues are >= 0, so min >= damping
+    # oracle: recompute from the stored eigen state
+    e = state["eigen"]["fc"]
+    want = float(jnp.min(e["dG"]) * jnp.min(e["dA"]) + 0.01)
+    np.testing.assert_allclose(me, want, rtol=1e-6)
+    # a non-eigen step recomputes nu but carries min_damped_eig
+    _, state2 = kfac.update(
+        grads, state, lr=0.1, damping=0.01,
+        update_factors=False, update_eigen=False,
+    )
+    np.testing.assert_allclose(
+        float(state2["diagnostics"]["min_damped_eig"]), me, rtol=0
+    )
+    # diagnostics stay out of the state unless asked (pytree stability)
+    assert "diagnostics" not in KFAC(damping=0.01).init(params)
