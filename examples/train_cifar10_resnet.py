@@ -133,6 +133,12 @@ def parse_args(argv=None):
                    help="log per-epoch K-FAC stability telemetry (KL-clip "
                         "coefficient nu min/mean, min damped eigenvalue) to "
                         "--log-dir")
+    p.add_argument("--bn-recal-batches", type=int, default=0,
+                   help="refresh BatchNorm running statistics with this many "
+                        "clean train-mode forwards before each eval (0 = "
+                        "reference parity). Removes the transient val-accuracy "
+                        "dips caused by stale BN EMAs at high lr "
+                        "(training/step.py::make_bn_recal_step)")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
@@ -252,6 +258,13 @@ def main(argv=None):
     eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}
     )
+    bn_recal = None
+    if args.bn_recal_batches:
+        from kfac_pytorch_tpu.training.step import make_bn_recal_step
+
+        # built once: a per-epoch make_* call would be a fresh jit wrapper
+        # (and a recompile) every epoch
+        bn_recal = make_bn_recal_step(model, {"train": True})
     lr_factor = create_lr_schedule(world, args.warmup_epochs, args.lr_decay)
 
     cifar_dir = None if args.synthetic else data_lib.find_cifar10(args.data_dir)
@@ -377,6 +390,15 @@ def main(argv=None):
                       f"min_damped_eig={eig_min:.3e}")
 
         if x_val is not None:
+            if bn_recal is not None and x_train is not None:
+                for j, (xb, _) in enumerate(data_lib.epoch_batches(
+                    x_train, y_train, local_bs, shuffle=True, augment=False,
+                    seed=args.seed + 1000 + epoch,
+                    num_shards=n_proc, shard_index=launch.rank(),
+                )):
+                    if j >= args.bn_recal_batches:
+                        break
+                    state = bn_recal(state, put_global_batch(mesh, (xb,))[0])
             # full-split masked eval: the jitted step reduces over the GLOBAL
             # batch, so the sums below are already pod-wide — no allreduce
             val_bs = args.val_batch_size * world // n_proc

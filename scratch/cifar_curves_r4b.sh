@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 pair B — the HEADLINE curves: same recipe as pair A
+# (scratch/cifar_curves_r4.sh) plus BatchNorm recalibration before each
+# eval (--bn-recal-batches 30). Pair A established that the val-accuracy
+# dips at peak lr are an eval-time BN-staleness artifact (train-mode
+# accuracy and the K-FAC diagnostics stay healthy through them, and the
+# SGD twin dips in the same regime); pair B removes the artifact so the
+# per-epoch optimizer comparison is clean. 12 epochs with the decay
+# schedule scaled (8/11) to fit the box's wall-clock.
+set -u
+cd /root/repo
+export KFAC_FORCE_PLATFORM=cpu:4
+LOG=/tmp/cifar_curves_r4b.log
+run() {
+  name=$1; shift
+  # completion sentinel, not scalars.jsonl: ScalarWriter creates that
+  # file at run START, so a killed half-run would otherwise be skipped
+  # forever on rerun
+  if [ -f "logs/$name/.done" ]; then
+    echo "[skip] $name (complete)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  rc=$?
+  [ $rc -eq 0 ] && touch "logs/$name/.done"
+  echo "[$(date +%H:%M:%S)] done $name rc=$rc" >> "$LOG"
+}
+
+CIFAR="python examples/train_cifar10_resnet.py --model resnet32 --batch-size 16 --epochs 12 --lr-decay 8 11 --steps-per-epoch 200 --bn-recal-batches 30 --seed 42"
+
+run cifar10_resnet32_kfac_recal_r4 $CIFAR \
+  --kfac-update-freq 10 --kfac-cov-update-freq 10 \
+  --precond-precision default --eigen-dtype bf16 --kfac-diagnostics
+run cifar10_resnet32_sgd_recal_r4 $CIFAR --kfac-update-freq 0
+
+echo "[$(date +%H:%M:%S)] pair B done" >> "$LOG"

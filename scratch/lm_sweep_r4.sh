@@ -16,31 +16,43 @@ export KFAC_FORCE_PLATFORM=cpu:1
 LOG=/tmp/lm_sweep_r4.log
 run() {
   name=$1; shift
-  if [ -f "logs/$name/scalars.jsonl" ]; then
-    echo "[skip] $name (exists)" >> "$LOG"; return 0
+  # completion sentinel, not scalars.jsonl: ScalarWriter creates that
+  # file at run START, so a killed half-run would otherwise be skipped
+  # forever on rerun
+  if [ -f "logs/$name/.done" ]; then
+    echo "[skip] $name (complete)" >> "$LOG"; return 0
   fi
   echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
   "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
-  echo "[$(date +%H:%M:%S)] done $name rc=$?" >> "$LOG"
+  rc=$?
+  [ $rc -eq 0 ] && touch "logs/$name/.done"
+  echo "[$(date +%H:%M:%S)] done $name rc=$rc" >> "$LOG"
 }
 
-LSTM="python examples/train_wikitext_rnn.py --synthetic --epochs 6 --emsize 256 --nhid 256 --seed 42"
+# --steps-per-epoch caps bound each arm's wall-clock on the 1-core box;
+# identical caps across arms keep every comparison exact.
+LSTM="python examples/train_wikitext_rnn.py --synthetic --epochs 6 --emsize 256 --nhid 256 --steps-per-epoch 300 --seed 42"
+
+# Arm order = evidence priority (the round can end mid-sweep; each arm
+# commits its own log dir as it finishes and reruns skip existing):
+# 1-2: the headline LSTM pair, 3-4: the transformer twins, then controls.
 
 # reference-recipe SGD twin (lr 20 is the reference wikitext default)
 run wikitext_lstm_sgd_r4 $LSTM --kfac-update-freq 0
-# lr-control: does plain SGD prefer the K-FAC arm's lr? (it should not —
-# otherwise a K-FAC "win" below would just be an lr effect)
-run wikitext_lstm_sgd_lr5_r4 $LSTM --kfac-update-freq 0 --base-lr 5
-# r3-parity K-FAC (the loser): lr 20, kl-clip 0.001 — kept for the record
-run wikitext_lstm_kfac_parity_r4 $LSTM --kfac-update-freq 10
 # tuned K-FAC: per-optimizer lr + a trust region that admits the
 # preconditioned step (nu = sqrt(kl_clip)/lr at the clip boundary)
 run wikitext_lstm_kfac_tuned_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01
-# tuned + embedding preconditioning (beyond-reference lever)
-run wikitext_lstm_kfac_emb_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01 --kfac-embedding
 
-TRANS="python examples/train_transformer_lm.py --synthetic --epochs 4 --d-model 256 --n-layers 2 --seq-len 128 --batch-size 16 --seed 42"
+TRANS="python examples/train_transformer_lm.py --synthetic --epochs 4 --d-model 256 --n-layers 2 --seq-len 128 --batch-size 16 --steps-per-epoch 200 --seed 42"
 run transformer_lm_kfac_r4 $TRANS --kfac-update-freq 10
 run transformer_lm_sgd_r4 $TRANS --kfac-update-freq 0
+
+# lr-control: does plain SGD prefer the K-FAC arm's lr? (it should not —
+# otherwise the K-FAC "win" above would just be an lr effect)
+run wikitext_lstm_sgd_lr5_r4 $LSTM --kfac-update-freq 0 --base-lr 5
+# tuned + embedding preconditioning (beyond-reference lever)
+run wikitext_lstm_kfac_emb_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01 --kfac-embedding
+# r3-parity K-FAC (the loser): lr 20, kl-clip 0.001 — kept for the record
+run wikitext_lstm_kfac_parity_r4 $LSTM --kfac-update-freq 10
 
 echo "[$(date +%H:%M:%S)] sweep done" >> "$LOG"

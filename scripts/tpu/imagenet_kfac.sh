@@ -28,7 +28,11 @@ gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
     --kfac-cov-update-freq 10 \
     --damping 0.001 \
     --distribute-precondition \
-    --precond-comm-dtype bf16"
+    --precond-comm-dtype bf16 \
+    --grad-comm-dtype bf16"
 # --distribute-precondition: at 64 chips the fixed every-step rotation tax
 # (~2.2e11 FLOPs on ResNet-50, docs/PERF.md) shards ~1/64 instead of running
-# replicated on every chip; the bf16 comm dtype halves the exchange bytes.
+# replicated on every chip; the bf16 comm dtypes halve the wire bytes of the
+# precondition exchange AND the per-step DP gradient mean (the latter is the
+# reference's --fp16-allreduce; it matters most where the mean crosses DCN
+# between hosts).

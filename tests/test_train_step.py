@@ -178,3 +178,28 @@ def test_kfac_flags_for_step_gating():
     assert kfac_flags_for_step(0, kfac_w, epoch=5)["diag_warmup_done"] is True
     # no epoch passed → no warmup gating, like the reference's warning path
     assert kfac_flags_for_step(0, kfac_w)["diag_warmup_done"] is True
+
+
+def test_bn_recal_step_updates_stats_only():
+    """make_bn_recal_step refreshes batch_stats toward the current data and
+    touches nothing else (no param/opt change, no step increment)."""
+    from kfac_pytorch_tpu.training.step import make_bn_recal_step
+
+    model, state, _, (x, _) = _setup()
+    before_params = jax.device_get(state.params)
+    before_stats = jax.device_get(state.batch_stats)
+    before_step = int(jax.device_get(state.step))
+    recal = make_bn_recal_step(model, {"train": True})
+    state2 = recal(state, x)  # donates state
+    after_params = jax.device_get(state2.params)
+    after_stats = jax.device_get(state2.batch_stats)
+    for a, b in zip(jax.tree_util.tree_leaves(before_params),
+                    jax.tree_util.tree_leaves(after_params)):
+        np.testing.assert_array_equal(a, b)
+    diffs = [
+        float(np.abs(a - b).max())
+        for a, b in zip(jax.tree_util.tree_leaves(before_stats),
+                        jax.tree_util.tree_leaves(after_stats))
+    ]
+    assert max(diffs) > 0.0, "batch_stats unchanged by recalibration"
+    assert int(jax.device_get(state2.step)) == before_step
