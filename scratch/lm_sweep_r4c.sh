@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 LM evidence sweep, take 2: the REAL code corpus (the r3 runs'
+# data, scripts/make_code_corpus.py) with FULL epochs — the first take's
+# --synthetic + 300-step cap starved both optimizers (val loss flat at
+# ~5.23), making its 6/6 K-FAC "win" vacuous. Full epochs here reproduce
+# the r3 regime (LSTM SGD reaches ~3.06 val loss in 5 epochs), so the
+# K-FAC comparison is against a twin that actually learns.
+#
+# Hypothesis under test (r3 verdict #4): the r3 LSTM K-FAC loss came from
+# the KL trust region overclamping at the reference's raw-SGD lr=20
+# (nu = sqrt(kl_clip)/lr at the boundary) — per-optimizer lr + wider clip
+# should flip it. Controls: sgd at the K-FAC arm's lr (pure lr effect?),
+# the r3-parity config (for the record), +embedding preconditioning.
+set -u
+cd /root/repo
+export KFAC_FORCE_PLATFORM=cpu:1
+LOG=/tmp/lm_sweep_r4c.log
+DATA=/tmp/code-corpus
+run() {
+  name=$1; shift
+  if [ -f "logs/$name/.done" ]; then
+    echo "[skip] $name (complete)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  rc=$?
+  [ $rc -eq 0 ] && touch "logs/$name/.done"
+  echo "[$(date +%H:%M:%S)] done $name rc=$rc" >> "$LOG"
+}
+
+LSTM="python examples/train_wikitext_rnn.py --data-dir $DATA --epochs 6 --emsize 256 --nhid 256 --steps-per-epoch 1000 --seed 42"
+
+# priority order: headline pair, transformer twins, then controls
+run wikitext_lstm_sgd_cc_r4 $LSTM --kfac-update-freq 0
+run wikitext_lstm_kfac_tuned_cc_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01
+
+TRANS="python examples/train_transformer_lm.py --data-dir $DATA --epochs 4 --d-model 256 --n-layers 2 --seq-len 128 --batch-size 16 --steps-per-epoch 600 --seed 42"
+run transformer_lm_kfac_cc_r4 $TRANS --kfac-update-freq 10
+run transformer_lm_sgd_cc_r4 $TRANS --kfac-update-freq 0
+
+run wikitext_lstm_sgd_lr5_cc_r4 $LSTM --kfac-update-freq 0 --base-lr 5
+run wikitext_lstm_kfac_emb_cc_r4 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01 --kfac-embedding
+run wikitext_lstm_kfac_parity_cc_r4 $LSTM --kfac-update-freq 10
+
+echo "[$(date +%H:%M:%S)] sweep done" >> "$LOG"
