@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LANES = 128  # TPU lane width: minor dim of the lane-replicated row stats
 logger = logging.getLogger(__name__)
 _warned: set = set()
 
@@ -54,7 +55,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     across those revisits, so only ONE [block_k, D] K/V tile is resident at
     a time (VMEM stays O(block) however long the sequence). Refs (leading
     singleton = batch·head): q/o [1, block_q, D]; k/v [1, block_k, D];
-    lse [1, block_q] (logsumexp of the scaled logits, the backward residual).
+    lse [1, block_q, _LANES] (logsumexp of the scaled logits, the backward
+    residual, replicated across the 128-lane minor dim — Mosaic requires the
+    last two block dims be (8k, 128m) or whole-array, so a [1, block_q]
+    per-row vector is unlowerable; lane-replicating is the standard layout,
+    cf. jax's own pallas.ops.tpu.flash_attention which stores l/m the same
+    way. The interpreter accepts either, which is why this only failed the
+    first time the kernel met real hardware).
     """
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -87,21 +94,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 jnp.int32, (block_q, block_k), 1
             )
             logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
-        m = m_scr[:]
+        m = m_scr[:]  # (block_q, _LANES), lanes identical
         m_new = jnp.maximum(m, jnp.max(logits, axis=1, keepdims=True))
-        p = jnp.exp(logits - m_new)
+        p = jnp.exp(logits - m_new[:, :1])
         corr = jnp.exp(m - m_new)
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+        acc_scr[:] = acc_scr[:] * corr[:, :1] + jnp.dot(
             p, vb, preferred_element_type=jnp.float32
         )
 
     @pl.when(kj == nk - 1)
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        o_ref[0] = (acc_scr[:] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
@@ -123,15 +130,15 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -168,8 +175,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = dl_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = dl_ref[0][:, :1]
         logits = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -221,8 +228,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = dl_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = dl_ref[0][:, :1]
         logits = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
@@ -260,12 +267,14 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
     qb, kb, vb, dob, ob = bh(q), bh(k), bh(v), bh(g), bh(out)
-    # Δ_i = Σ_d dO_id · O_id — one fused elementwise+reduce pass, [B·H, T]
+    # Δ_i = Σ_d dO_id · O_id — one fused elementwise+reduce pass, then
+    # lane-replicated to the stats layout (see _fwd_kernel docstring)
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0))
-    r_spec = pl.BlockSpec((1, block_q), lambda i, j, kk: (i, j))
+    r_spec = pl.BlockSpec((1, block_q, _LANES), lambda i, j, kk: (i, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
@@ -283,7 +292,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     # dK/dV grid: (heads, k-blocks, q-blocks) — q innermost
     kq_spec = pl.BlockSpec((1, block_q, d), lambda i, kk, j: (i, j, 0))
     kk_spec = pl.BlockSpec((1, block_k, d), lambda i, kk, j: (i, kk, 0))
-    kr_spec = pl.BlockSpec((1, block_q), lambda i, kk, j: (i, j))
+    kr_spec = pl.BlockSpec((1, block_q, _LANES), lambda i, kk, j: (i, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
         grid=(b * h, t // block_k, t // block_q),

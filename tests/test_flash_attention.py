@@ -88,7 +88,11 @@ def test_tpu_hardware_forward():
     q, k, v = _qkv(b=2, t=512, h=4, d=64, seed=6)
     out = flash_attention(q, k, v, causal=True)
     ref = full_attention(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    # Both paths run bf16 MXU matmuls on real hardware but block/accumulate
+    # in different orders, so they disagree by a few bf16 ULPs (eps ~7.8e-3)
+    # on O(1) values — measured max |diff| 5.5e-3 over 2^18 elements. The
+    # exact-math check is the interpreter test above (f32, tol 2e-5).
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
 
 
 @pytest.mark.skipif(not _on_tpu, reason="needs a real TPU (Mosaic compile)")
