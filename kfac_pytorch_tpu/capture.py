@@ -20,6 +20,32 @@ from kfac_pytorch_tpu.ops import factors
 
 PyTree = Any
 
+# Grouped-conv pseudo-layer naming: a KFACConv with feature_group_count=G
+# sows a stacked [G, a, a] A contribution and is expanded into G entries
+# "path#g0".."path#g{G-1}" — each an ordinary same-shape layer to everything
+# downstream (factor EMA, bucketed eigh, stacked rotations, round-robin
+# assignment). "#" cannot appear in flax module paths, so the suffix is
+# unambiguous.
+GROUP_SEP = "#g"
+
+
+def split_group_name(name: str) -> Tuple[str, Any]:
+    """``"path#g3" -> ("path", 3)``; ungrouped ``"path" -> ("path", None)``."""
+    base, sep, idx = name.rpartition(GROUP_SEP)
+    if not sep:
+        return name, None
+    return base, int(idx)
+
+
+def group_counts(names: List[str]) -> Dict[str, int]:
+    """``{base_path: G}`` for every grouped base present in ``names``."""
+    counts: Dict[str, int] = {}
+    for n in names:
+        base, gi = split_group_name(n)
+        if gi is not None:
+            counts[base] = max(counts.get(base, 0), gi + 1)
+    return counts
+
 
 def _flatten_with_paths(tree: PyTree) -> List[Tuple[Tuple[str, ...], Any]]:
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -55,15 +81,25 @@ def layer_names(params: PyTree) -> List[str]:
 
 
 def layer_names_from_capture(captured: PyTree) -> List[str]:
-    """Authoritative layer list: paths that sowed an A contribution."""
+    """Authoritative layer list: paths that sowed an A contribution.
+
+    A rank-3 contribution ``[G, a, a]`` marks a grouped conv, expanded into
+    G ``path#gK`` pseudo-layers (rank 2 = dense/conv, rank 1 = embedding
+    diagonal).
+    """
     names = []
-    for keys, _ in _flatten_with_paths(captured):
+    for keys, leaf in _flatten_with_paths(captured):
         if keys[-1] == A_CONTRIB or (
             len(keys) >= 2 and keys[-2] == A_CONTRIB
         ):  # sow may wrap the leaf in a tuple (path gains an index key)
             name = "/".join(keys[: -1 if keys[-1] == A_CONTRIB else -2])
-            if name not in names:
-                names.append(name)
+            if len(getattr(leaf, "shape", ())) == 3:
+                expanded = [f"{name}{GROUP_SEP}{k}" for k in range(leaf.shape[0])]
+            else:
+                expanded = [name]
+            for n in expanded:
+                if n not in names:
+                    names.append(n)
     return names
 
 
@@ -87,29 +123,67 @@ def _get_path(tree: PyTree, name: str) -> Any:
 
 
 def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndarray]]:
-    """Pull ``{'kernel': ..., 'bias'?: ...}`` grad dicts for each K-FAC layer."""
+    """Pull ``{'kernel': ..., 'bias'?: ...}`` grad dicts for each K-FAC layer.
+
+    Grouped pseudo-layers get their group's output-channel slice of the
+    kernel/bias grads (a grouped HWIO kernel's O axis is partitioned by
+    group; its I axis is already per-group).
+    """
+    counts = group_counts(names)
     out = {}
     for name in names:
-        node = _get_path(grads, name)
+        base, gi = split_group_name(name)
+        node = _get_path(grads, base)
         if "embedding" in node:
             out[name] = {"embedding": node["embedding"]}
             continue
-        entry = {"kernel": node["kernel"]}
-        if "bias" in node:
-            entry["bias"] = node["bias"]
+        kernel = node["kernel"]
+        bias = node.get("bias")
+        if gi is not None:
+            co_g = kernel.shape[-1] // counts[base]
+            kernel = kernel[..., gi * co_g:(gi + 1) * co_g]
+            if bias is not None:
+                bias = bias[gi * co_g:(gi + 1) * co_g]
+        entry = {"kernel": kernel}
+        if bias is not None:
+            entry["bias"] = bias
         out[name] = entry
     return out
 
 
 def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
-    """Pull per-layer A-factor contributions from the ``kfac_acts`` collection."""
+    """Pull per-layer A-factor contributions from the ``kfac_acts`` collection.
+
+    Grouped pseudo-layers read their row of the stacked ``[G, a, a]``
+    contribution.
+    """
+    counts = group_counts(names)
     out = {}
     for name in names:
-        leaf = _get_path(captured, name)[A_CONTRIB]
+        base, gi = split_group_name(name)
+        leaf = _get_path(captured, base)[A_CONTRIB]
         # sow reduce_fn=overwrite still wraps the value in a 1-tuple.
         if isinstance(leaf, tuple):
             leaf = leaf[-1]
-        out[name] = leaf
+        if gi is None:
+            out[name] = leaf
+            continue
+        # The sown [G, a, a] stack is the ground truth for G — enforce the
+        # contract that a grouped layer's pseudo-entries are kept/dropped as
+        # a COMPLETE set (a partial set would silently mis-derive the
+        # output-channel split everywhere group_counts is used).
+        present = sum(
+            1 for n in names if split_group_name(n)[0] == base
+            and split_group_name(n)[1] is not None
+        )
+        if counts[base] != leaf.shape[0] or present != leaf.shape[0]:
+            raise ValueError(
+                f"grouped layer {base!r}: layer list carries {present} "
+                f"pseudo-layers (max index {counts[base] - 1}) but the "
+                f"layer has {leaf.shape[0]} groups — keep all "
+                f"'{GROUP_SEP}K' entries of a grouped layer together"
+            )
+        out[name] = leaf[gi]
     return out
 
 
@@ -122,8 +196,25 @@ def g_factors(
     (kfac/utils.py:144-153): rank-4 cotangents are conv outputs (NHWC),
     rank-2/3 are dense outputs (possibly with a time axis).
     """
+    counts = group_counts(names)
+    # a grouped conv's output channels are partitioned by group; each
+    # group's G factor is the covariance of its own slice — computed as ONE
+    # batched contraction per base layer (512 sliced matmuls for ResNeXt-50
+    # otherwise), then indexed per pseudo-layer
+    stacked = {
+        base: factors.compute_g_conv_grouped(
+            _get_path(perturb_grads, base)[OUT_PERTURB].astype(jnp.float32),
+            n_groups,
+            batch_averaged=batch_averaged,
+        )
+        for base, n_groups in counts.items()
+    }
     out = {}
     for name in names:
+        base, gi = split_group_name(name)
+        if gi is not None:
+            out[name] = stacked[base][gi]
+            continue
         g = _get_path(perturb_grads, name)[OUT_PERTURB]
         if g.ndim == 4:
             out[name] = factors.compute_g_conv(
@@ -158,7 +249,12 @@ def write_back(
         return node
 
     grads = _deep_copy(grads)
+    grouped: Dict[str, Dict[int, jnp.ndarray]] = {}
     for name, mat in updates.items():
+        base, gi = split_group_name(name)
+        if gi is not None:
+            grouped.setdefault(base, {})[gi] = mat
+            continue
         node = _get_path(grads, name)
         if "embedding" in node:
             # [features, vocab] mat back to the [vocab, features] table
@@ -171,6 +267,34 @@ def write_back(
         node["kernel"] = new["kernel"].astype(node["kernel"].dtype)
         if "bias" in node:
             node["bias"] = new["bias"].astype(node["bias"].dtype)
+    for base, parts in grouped.items():
+        # reassemble the per-group [co_g, a] updates along the O axis; the
+        # complete-set contract (every group present, validated against the
+        # sown stack in a_contribs) makes max-index+1 the group count
+        node = _get_path(grads, base)
+        kh, kw, ci_g, cout = node["kernel"].shape
+        n_groups = max(parts) + 1
+        if len(parts) != n_groups:
+            raise ValueError(
+                f"grouped layer {base!r}: updates carry {len(parts)} of "
+                f"{n_groups} pseudo-layer groups — keep all '{GROUP_SEP}K' "
+                "entries of a grouped layer together"
+            )
+        co_g = cout // n_groups
+        has_bias = "bias" in node
+        kernels, biases = [], []
+        for gi in range(n_groups):
+            sub = factors.mat_to_grads(
+                parts[gi] * nu, (kh, kw, ci_g, co_g), has_bias
+            )
+            kernels.append(sub["kernel"])
+            if has_bias:
+                biases.append(sub["bias"])
+        node["kernel"] = jnp.concatenate(kernels, axis=-1).astype(
+            node["kernel"].dtype
+        )
+        if has_bias:
+            node["bias"] = jnp.concatenate(biases).astype(node["bias"].dtype)
     return grads
 
 

@@ -8,13 +8,18 @@ ResNeXt variants, wide variants, zero-init of the last BN gamma per block
 False for parity), no pretrained weights (the reference raises on
 ``pretrained=True``, examples/imagenet_resnet.py:235).
 
-K-FAC capture: all non-grouped convs and the final dense head are
-capture-aware. Grouped convs (ResNeXt) are intentionally *not* preconditioned
-— the reference's factor math is shape-inconsistent for ``groups > 1`` (its
+K-FAC capture: every conv (grouped included) and the final dense head are
+capture-aware. Grouped convs (ResNeXt) precondition as G independent
+Kronecker pairs per layer (``KFACConv(feature_group_count=G)``; capture.py
+expands them into per-group pseudo-layers) — BEYOND-reference capability:
+the reference's factor math is shape-inconsistent for ``groups > 1`` (its
 ``ComputeA`` builds an ``in·kh·kw`` factor against an ``in/groups·kh·kw``
 grad matrix, kfac/utils.py:108-117 vs kfac_preconditioner.py:279-281, which
-would crash); we instead train them with plain SGD like BN params, which is
-well-defined and lets ResNeXt actually run under K-FAC.
+would crash), so its ResNeXt zoo cannot run under K-FAC at all. Note the
+pseudo-layer count is groups × grouped-layers (512 for ResNeXt-50 32x4d):
+the per-group factors batch into a handful of stacked eigh/rotation calls
+at run time, but the first compile of the factor-update step is
+correspondingly slower (minutes, one-time, cached).
 """
 
 from __future__ import annotations
@@ -24,52 +29,18 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
-from jax import lax
 
 from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense
 
 _kaiming = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 
-class _GroupedConv(nn.Module):
-    """Plain grouped conv (NOT K-FAC captured — see module docstring)."""
-
-    features: int
-    kernel_size: Tuple[int, int]
-    strides: Tuple[int, int]
-    padding: Any
-    groups: int
-    dtype: Any = None
-
-    @nn.compact
-    def __call__(self, x):
-        kh, kw = self.kernel_size
-        kernel = self.param(
-            "kernel",
-            _kaiming,
-            (kh, kw, x.shape[-1] // self.groups, self.features),
-            jnp.float32,
-        )
-        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
-        return lax.conv_general_dilated(
-            x,
-            kernel,
-            window_strides=self.strides,
-            padding=self.padding,
-            feature_group_count=self.groups,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-
-
 def _conv(features, kernel_size, strides=(1, 1), padding=((0, 0), (0, 0)),
           groups=1, dtype=None, name=None):
-    if groups == 1:
-        return KFACConv(
-            features, kernel_size, strides=strides, padding=padding,
-            use_bias=False, kernel_init=_kaiming, dtype=dtype, name=name,
-        )
-    return _GroupedConv(
-        features, kernel_size, strides, padding, groups, dtype=dtype, name=name
+    return KFACConv(
+        features, kernel_size, strides=strides, padding=padding,
+        feature_group_count=groups, use_bias=False, kernel_init=_kaiming,
+        dtype=dtype, name=name,
     )
 
 

@@ -168,6 +168,12 @@ class KFACConv(_KFACLayer):
     155-170). The A-factor contribution runs the same patch extraction the
     conv itself uses, so stride/padding/dilation stay consistent by
     construction.
+
+    ``feature_group_count > 1`` (grouped conv, e.g. ResNeXt) is captured as
+    G independent Kronecker pairs — the sown A contribution is stacked
+    ``[G, a, a]`` and capture.py expands the layer into ``name#gK``
+    pseudo-layers. BEYOND-reference: the reference cannot precondition
+    grouped convs (its im2col factor shape is inconsistent for groups > 1).
     """
 
     features: int
@@ -175,6 +181,7 @@ class KFACConv(_KFACLayer):
     strides: Tuple[int, int] = (1, 1)
     padding: Padding = "SAME"
     kernel_dilation: Tuple[int, int] = (1, 1)
+    feature_group_count: int = 1
     use_bias: bool = False
     dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
@@ -184,10 +191,11 @@ class KFACConv(_KFACLayer):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         kh, kw = self.kernel_size
+        groups = self.feature_group_count
         kernel = self.param(
             "kernel",
             self.kernel_init,
-            (kh, kw, x.shape[-1], self.features),
+            (kh, kw, x.shape[-1] // groups, self.features),
             self.param_dtype,
         )
         if self.use_bias:
@@ -196,16 +204,29 @@ class KFACConv(_KFACLayer):
             bias = None
 
         padding = _normalize_padding(self.padding)
-        self._sow_a(
-            lambda: factors.compute_a_conv(
-                x.astype(jnp.float32),
-                self.kernel_size,
-                self.strides,
-                padding,
-                has_bias=self.use_bias,
-                kernel_dilation=self.kernel_dilation,
+        if groups == 1:
+            self._sow_a(
+                lambda: factors.compute_a_conv(
+                    x.astype(jnp.float32),
+                    self.kernel_size,
+                    self.strides,
+                    padding,
+                    has_bias=self.use_bias,
+                    kernel_dilation=self.kernel_dilation,
+                )
             )
-        )
+        else:
+            self._sow_a(
+                lambda: factors.compute_a_conv_grouped(
+                    x.astype(jnp.float32),
+                    groups,
+                    self.kernel_size,
+                    self.strides,
+                    padding,
+                    has_bias=self.use_bias,
+                    kernel_dilation=self.kernel_dilation,
+                )
+            )
 
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = lax.conv_general_dilated(
@@ -214,6 +235,7 @@ class KFACConv(_KFACLayer):
             window_strides=self.strides,
             padding=padding,
             rhs_dilation=self.kernel_dilation,
+            feature_group_count=groups,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         if bias is not None:

@@ -242,7 +242,8 @@ class KFAC:
         is_conv = {}
         for name in names:
             node = params
-            for k in name.split("/"):
+            # grouped pseudo-layers ("path#gK") share the base path's params
+            for k in capture.split_group_name(name)[0].split("/"):
                 node = node[k]
             # embedding layers (no "kernel" param) are neither conv nor dense
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
@@ -269,10 +270,12 @@ class KFAC:
         reference's ``steps == 0`` behavior (``A₀ = decay·I + (1−decay)·a``).
         """
         names, _ = self._layer_meta(params)
+        gcounts = capture.group_counts(names)
         facs, eigen = {}, {}
         for name in names:
+            base, group_idx = capture.split_group_name(name)
             node = params
-            for k in name.split("/"):
+            for k in base.split("/"):
                 node = node[k]
             if "embedding" in node:
                 # Diagonal-A (embedding) layer: A is a [vocab] vector whose
@@ -301,6 +304,10 @@ class KFAC:
             has_bias = "bias" in node
             if kernel.ndim == 4:
                 kh, kw, cin, cout = kernel.shape
+                if group_idx is not None:
+                    # grouped conv pseudo-layer: the HWIO I axis is already
+                    # per-group; the O axis splits across the G groups
+                    cout = cout // gcounts[base]
                 a_side = cin * kh * kw + int(has_bias)
                 g_side = cout
             else:
@@ -389,7 +396,8 @@ class KFAC:
         is_conv = {}
         for name in names:
             node = grads
-            for k in name.split("/"):
+            # grouped pseudo-layers ("path#gK") share the base path's grads
+            for k in capture.split_group_name(name)[0].split("/"):
                 node = node[k]
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
 

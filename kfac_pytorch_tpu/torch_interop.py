@@ -14,10 +14,11 @@ layout differences:
 * module naming: torch's nested ``layer{s}.{i}`` blocks → flax's flat
   auto-numbered ``BasicBlock_i``/``Bottleneck_i`` (same traversal order)
 
-Grouped-conv variants (ResNeXt) are rejected: their grouped 3×3 is excluded
-from K-FAC here and uses a different module layout (imagenet_resnet.py
-top-of-file note), so a converted checkpoint could not be preconditioned
-equivalently anyway.
+Grouped-conv variants (ResNeXt) convert like any other bottleneck arch:
+``KFACConv`` carries ``feature_group_count``, so the module layout is
+uniform and groups only change tensor shapes, which the name-driven
+conversion carries through (and the imported model preconditions per-group,
+imagenet_resnet.py top-of-file note).
 
 Everything is numpy-only — tensors are accepted as anything
 ``np.asarray`` understands (torch CPU tensors included), so this module
@@ -37,6 +38,11 @@ _ARCHS = {
     "resnet50": ("bottleneck", [3, 4, 6, 3]),
     "resnet101": ("bottleneck", [3, 4, 23, 3]),
     "resnet152": ("bottleneck", [3, 8, 36, 3]),
+    # ResNeXt: since grouped convs became ordinary KFACConv modules the
+    # param layout is identical to bottleneck ResNet (the conversion is
+    # name-driven; groups only change tensor shapes, which carry through)
+    "resnext50_32x4d": ("bottleneck", [3, 4, 6, 3]),
+    "resnext101_32x8d": ("bottleneck", [3, 4, 23, 3]),
     "wide_resnet50_2": ("bottleneck", [3, 4, 6, 3]),
     "wide_resnet101_2": ("bottleneck", [3, 4, 23, 3]),
 }
@@ -107,8 +113,7 @@ def convert_state_dict(
     if arch not in _ARCHS:
         supported = ", ".join(sorted(_ARCHS))
         raise ValueError(
-            f"unsupported arch {arch!r} (supported: {supported}; ResNeXt's "
-            "grouped convs use a different K-FAC-exclusion layout)"
+            f"unsupported arch {arch!r} (supported: {supported})"
         )
     kind, stages = _ARCHS[arch]
     block_name = "BasicBlock" if kind == "basic" else "Bottleneck"

@@ -1,0 +1,211 @@
+"""Grouped-conv K-FAC (per-group pseudo-layers) — beyond-reference.
+
+The oracle: a conv with ``feature_group_count=G`` IS G independent convs on
+channel slices, so K-FAC on one grouped ``KFACConv`` must match K-FAC on a
+structurally explicit model with G separate ungrouped ``KFACConv``s whose
+outputs are concatenated — factors, preconditioned grads, the KL-clip
+coefficient, end to end. (The reference cannot run this at all: its
+``ComputeA`` builds an ``in·kh·kw`` factor against an ``in/groups·kh·kw``
+weight matrix, kfac/utils.py:107-117.)
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu import KFAC, capture
+from kfac_pytorch_tpu.models.layers import (
+    KFAC_ACTS,
+    PERTURBATIONS,
+    KFACConv,
+    KFACDense,
+)
+from kfac_pytorch_tpu.ops import factors as F
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+
+B, H, W, C, FEAT, G = 4, 6, 6, 8, 8, 2
+
+
+class _Grouped(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        y = KFACConv(FEAT, (3, 3), padding="SAME", feature_group_count=G,
+                     name="gc")(x)
+        y = nn.relu(y).mean(axis=(1, 2))
+        return KFACDense(3, name="head")(y)
+
+
+class _Explicit(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        cg = C // G
+        parts = [
+            KFACConv(FEAT // G, (3, 3), padding="SAME", name=f"g{k}")(
+                x[..., k * cg:(k + 1) * cg]
+            )
+            for k in range(G)
+        ]
+        y = jnp.concatenate(parts, axis=-1)
+        y = nn.relu(y).mean(axis=(1, 2))
+        return KFACDense(3, name="head")(y)
+
+
+def _x(seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(B, H, W, C).astype(np.float32)
+    )
+
+
+def _tie_explicit_params(gp):
+    """Explicit-model params carrying the grouped model's weights."""
+    k = gp["gc"]["kernel"]  # [3, 3, C/G, FEAT]
+    co = FEAT // G
+    out = {f"g{i}": {"kernel": k[..., i * co:(i + 1) * co]} for i in range(G)}
+    out["head"] = gp["head"]
+    return out
+
+
+def test_grouped_forward_matches_flax_conv():
+    m = _Grouped()
+    vs = m.init(jax.random.PRNGKey(0), _x())
+    y = m.apply({"params": vs["params"]}, _x())
+    ref = nn.Conv(FEAT, (3, 3), padding="SAME", feature_group_count=G,
+                  use_bias=False)
+    yr = ref.apply({"params": {"kernel": vs["params"]["gc"]["kernel"]}}, _x())
+    yr = KFACDense(3, name="head").apply(
+        {"params": vs["params"]["head"]}, nn.relu(yr).mean(axis=(1, 2))
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=1e-5)
+
+
+def test_grouped_a_contrib_matches_per_group_slices():
+    x = _x(1)
+    got = F.compute_a_conv_grouped(x, G, (3, 3), (1, 1), "SAME", has_bias=False)
+    cg = C // G
+    for k in range(G):
+        want = F.compute_a_conv(
+            x[..., k * cg:(k + 1) * cg], (3, 3), (1, 1), "SAME", has_bias=False
+        )
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want),
+                                   atol=1e-6)
+
+
+def test_discovery_expands_pseudo_layers_and_init_shapes():
+    m = _Grouped()
+    names = capture.discover_layers(m, _x())
+    assert names == ["gc#g0", "gc#g1", "head"]
+    assert capture.group_counts(names) == {"gc": G}
+    vs = m.init(jax.random.PRNGKey(0), _x())
+    kfac = KFAC(damping=0.01, layers=names)
+    state = kfac.init(vs["params"])
+    a_side = (C // G) * 9  # per-group in-channels x 3x3, no bias
+    g_side = FEAT // G
+    for n in ("gc#g0", "gc#g1"):
+        assert state["factors"][n]["A"].shape == (a_side, a_side)
+        assert state["factors"][n]["G"].shape == (g_side, g_side)
+
+
+def test_grad_mats_write_back_roundtrip():
+    m = _Grouped()
+    x = _x(2)
+    vs = m.init(jax.random.PRNGKey(0), x)
+    names = capture.discover_layers(m, x)
+    grads = jax.grad(
+        lambda p: jnp.sum(m.apply({"params": p}, x) ** 2)
+    )(vs["params"])
+    gm = capture.grad_mats(capture.layer_grads(grads, names))
+    assert gm["gc#g0"].shape == (FEAT // G, (C // G) * 9)
+    new = capture.write_back(grads, gm, nu=jnp.float32(1.0))
+    np.testing.assert_allclose(
+        np.asarray(new["gc"]["kernel"]), np.asarray(grads["gc"]["kernel"]),
+        atol=1e-6,
+    )
+
+
+def _full_kfac_step(model, x, seed, method="eigen", mesh=None,
+                    distribute=False, tie_from=None):
+    """Capture + one factors+eigen+precondition update; returns new grads."""
+    vs = model.init(jax.random.PRNGKey(seed), x)
+    params = tie_from if tie_from is not None else vs["params"]
+    names = capture.discover_layers(model, x)
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+    _, mut = model.apply({"params": params, PERTURBATIONS: perts}, x,
+                         mutable=[KFAC_ACTS])
+
+    def loss(p, q):
+        return jnp.mean(model.apply({"params": p, PERTURBATIONS: q}, x) ** 2)
+
+    grads, gpert = jax.grad(loss, argnums=(0, 1))(params, perts)
+    a_c = capture.a_contribs(mut[KFAC_ACTS], names)
+    g_s = capture.g_factors(gpert, names, batch_averaged=True)
+    kfac = KFAC(damping=0.01, layers=names, precond_method=method,
+                mesh=mesh, distribute_precondition=distribute)
+    state = kfac.init(params)
+    new_grads, _ = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True,
+    )
+    return params, new_grads
+
+
+def _assert_grouped_matches_explicit(method):
+    x = _x(3)
+    gp, g_new = _full_kfac_step(_Grouped(), x, seed=4, method=method)
+    ep = _tie_explicit_params(gp)
+    _, e_new = _full_kfac_step(_Explicit(), x, seed=4, method=method,
+                               tie_from=ep)
+    co = FEAT // G
+    for k in range(G):
+        np.testing.assert_allclose(
+            np.asarray(g_new["gc"]["kernel"][..., k * co:(k + 1) * co]),
+            np.asarray(e_new[f"g{k}"]["kernel"]),
+            rtol=1e-4, atol=1e-6,
+        )
+    np.testing.assert_allclose(
+        np.asarray(g_new["head"]["kernel"]),
+        np.asarray(e_new["head"]["kernel"]),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_partial_pseudo_layer_set_rejected():
+    """Grouped pseudo-layers must be kept as a complete set — a partial
+    allowlist would silently mis-derive the output-channel split."""
+    import pytest
+
+    m = _Grouped()
+    x = _x(7)
+    vs = m.init(jax.random.PRNGKey(0), x)
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+    _, mut = m.apply({"params": vs["params"], PERTURBATIONS: perts}, x,
+                     mutable=[KFAC_ACTS])
+    for partial in (["gc#g1", "head"], ["gc#g0", "head"]):
+        with pytest.raises(ValueError, match="keep all"):
+            capture.a_contribs(mut[KFAC_ACTS], partial)
+
+
+def test_grouped_kfac_matches_explicit_groups_eigen():
+    _assert_grouped_matches_explicit("eigen")
+
+
+def test_grouped_kfac_matches_explicit_groups_inverse():
+    _assert_grouped_matches_explicit("inverse")
+
+
+def test_grouped_distributed_precondition_matches_replicated():
+    x = _x(5)
+    mesh = data_parallel_mesh()
+    _, rep = _full_kfac_step(_Grouped(), x, seed=6)
+    _, dist = _full_kfac_step(_Grouped(), x, seed=6, mesh=mesh,
+                              distribute=True)
+    for path in (("gc", "kernel"), ("head", "kernel")):
+        a, b = rep, dist
+        for k in path:
+            a, b = a[k], b[k]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

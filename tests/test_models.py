@@ -78,20 +78,26 @@ def test_imagenet_resnet50_forward():
     assert y.shape == (2, 1000)
 
 
-def test_resnext_grouped_convs_not_captured():
-    """Grouped convs are excluded from K-FAC (would be shape-inconsistent)."""
+def test_resnext_grouped_convs_captured_per_group():
+    """Grouped convs precondition as per-group pseudo-layers (beyond the
+    reference, whose factor math cannot handle groups > 1)."""
     m = imagenet_resnet.get_model("resnext50_32x4d")
     x = jnp.zeros((2, 64, 64, 3), jnp.float32)
     names = capture.discover_layers(m, x, train=True)
     assert names, "discovery found no layers"
-    # authoritative discovery (capture collection) excludes every grouped conv
-    assert all("GroupedConv" not in n for n in names)
-    # ...whereas the raw params heuristic would wrongly include them — the
-    # reason ResNeXt-style models must pass KFAC(layers=discover_layers(...))
+    grouped = [n for n in names if capture.GROUP_SEP in n]
+    assert grouped, "ResNeXt discovery found no grouped pseudo-layers"
+    counts = capture.group_counts(names)
+    # ResNeXt-50 32x4d: one 32-group 3x3 conv per bottleneck block (16 blocks)
+    assert len(counts) == 16
+    assert all(g == 32 for g in counts.values())
+    # pseudo-layer names resolve to their base's params (the raw heuristic
+    # cannot see groups — ResNeXt models must use discover_layers)
     vs = _init_abstract(m, (2, 64, 64, 3))
     heuristic = capture.layer_names(vs["params"])
-    assert any("GroupedConv" in n for n in heuristic)
-    assert set(names) <= set(heuristic)
+    ungrouped = [n for n in names if capture.GROUP_SEP not in n]
+    assert set(ungrouped) < set(heuristic)
+    assert {capture.split_group_name(n)[0] for n in grouped} <= set(heuristic)
 
 
 def test_unknown_model_name():

@@ -46,15 +46,19 @@ class _Basic(tnn.Module):
 class _Bottleneck(tnn.Module):
     expansion = 4
 
-    def __init__(self, cin, planes, stride=1):
+    def __init__(self, cin, planes, stride=1, groups=1, base_width=64):
         super().__init__()
         out = planes * 4
-        self.conv1 = tnn.Conv2d(cin, planes, 1, bias=False)
-        self.bn1 = tnn.BatchNorm2d(planes)
-        # v1.5: stride on the 3x3
-        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
-        self.bn2 = tnn.BatchNorm2d(planes)
-        self.conv3 = tnn.Conv2d(planes, out, 1, bias=False)
+        # torchvision width rule (ResNeXt/wide variants)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = tnn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        # v1.5: stride (and groups) on the 3x3
+        self.conv2 = tnn.Conv2d(
+            width, width, 3, stride, 1, groups=groups, bias=False
+        )
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, out, 1, bias=False)
         self.bn3 = tnn.BatchNorm2d(out)
         self.downsample = None
         if stride != 1 or cin != out:
@@ -75,7 +79,7 @@ class _Bottleneck(tnn.Module):
 class _TorchResNet(tnn.Module):
     """Standard-naming ResNet (conv1/bn1/layer{1..4}/fc)."""
 
-    def __init__(self, block, stages, num_classes=1000):
+    def __init__(self, block, stages, num_classes=1000, groups=1, base_width=64):
         super().__init__()
         self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
         self.bn1 = tnn.BatchNorm2d(64)
@@ -86,7 +90,10 @@ class _TorchResNet(tnn.Module):
             blocks = []
             for i in range(n):
                 stride = 2 if (s > 0 and i == 0) else 1
-                blocks.append(block(cin, planes, stride))
+                if block is _Bottleneck:
+                    blocks.append(block(cin, planes, stride, groups, base_width))
+                else:
+                    blocks.append(block(cin, planes, stride))
                 cin = planes * block.expansion
             setattr(self, f"layer{s + 1}", tnn.Sequential(*blocks))
         self.fc = tnn.Linear(cin, num_classes)
@@ -106,10 +113,11 @@ def _numpy_sd(net):
 
 def test_resnet18_forward_equivalence():
     torch.manual_seed(0)
-    net = _TorchResNet(_Basic, [2, 2, 2, 2]).eval()
-    # non-trivial running stats so the BN mapping is actually exercised
+    net = _TorchResNet(_Basic, [2, 2, 2, 2])
+    # warm-up in TRAIN mode: torch BN only updates running stats there, and
+    # non-trivial stats are what actually exercise the BN mapping
     with torch.no_grad():
-        net(torch.randn(4, 3, 64, 64))
+        net.train()(torch.randn(4, 3, 64, 64))
     net.eval()
     params, stats = torch_interop.convert_state_dict(_numpy_sd(net), "resnet18")
 
@@ -148,12 +156,38 @@ def test_resnet50_structure_matches_init():
     assert shapes(stats) == shapes(ref["batch_stats"])
 
 
+def test_resnext50_forward_equivalence():
+    """ResNeXt import: grouped convs convert like any other conv (the layout
+    became uniform once KFACConv grew feature_group_count); forward must
+    match the independent torch implementation."""
+    torch.manual_seed(0)
+    net = _TorchResNet(_Bottleneck, [3, 4, 6, 3], groups=32, base_width=4)
+    # warm-up in TRAIN mode so BN running stats leave their 0/1 init
+    with torch.no_grad():
+        net.train()(torch.randn(2, 3, 64, 64))
+    net.eval()
+    params, stats = torch_interop.convert_state_dict(
+        _numpy_sd(net), "resnext50_32x4d"
+    )
+
+    x = np.random.RandomState(2).randn(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        want = net(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    model = imagenet_resnet.get_model("resnext50_32x4d")
+    got = model.apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x),
+        train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
 def test_converter_error_paths():
     torch.manual_seed(0)
     net = _TorchResNet(_Basic, [2, 2, 2, 2])
     sd = _numpy_sd(net)
     with pytest.raises(ValueError, match="unsupported arch"):
-        torch_interop.convert_state_dict(sd, "resnext50_32x4d")
+        torch_interop.convert_state_dict(sd, "resnet1337")
     with pytest.raises(KeyError, match="missing"):
         bad = dict(sd)
         bad.pop("layer2.0.conv1.weight")
