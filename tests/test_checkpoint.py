@@ -42,6 +42,36 @@ def test_checkpoint_roundtrip_includes_kfac_state(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
 
 
+def test_checkpoint_roundtrip_grouped_pseudo_layers(tmp_path):
+    """'#gK' pseudo-layer keys in the curvature state must survive the
+    orbax/tensorstore path encoding."""
+    from kfac_pytorch_tpu import capture
+    from tests.test_grouped_conv import _Grouped, _x
+
+    m = _Grouped()
+    x = _x()
+    vs = m.init(jax.random.PRNGKey(0), x)
+    kfac = KFAC(layers=capture.discover_layers(m, x))
+    tx = make_sgd(momentum=0.9, weight_decay=0.0)
+    state = TrainState(
+        step=jnp.asarray(3, jnp.int32),
+        params=vs["params"],
+        batch_stats={},
+        opt_state=tx.init(vs["params"]),
+        kfac_state=kfac.init(vs["params"]),
+    )
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 1, state)
+    restored, _ = ckpt.auto_resume(d, state)
+    facs = restored.kfac_state["factors"]
+    assert {"gc#g0", "gc#g1", "head"} <= set(facs)
+    np.testing.assert_allclose(
+        np.asarray(facs["gc#g0"]["A"]),
+        np.asarray(state.kfac_state["factors"]["gc#g0"]["A"]),
+        atol=0,
+    )
+
+
 def test_latest_epoch_scans_newest(tmp_path):
     state = _state()
     d = str(tmp_path / "ckpts")
