@@ -1,0 +1,21 @@
+#!/bin/bash
+# Timing purity: SIGSTOP the CPU-side LM sweep while a TPU *bench* phase is
+# actively measuring (the pipelined windows are host-dispatch sensitive on
+# this 1-core box), SIGCONT it otherwise. Convergence phases don't need the
+# core quiet — only the bench/bench_precond phases do.
+#
+# "Actively measuring" = the LAST status line is a bench start; once the
+# phase logs rc= (or the queue moves on) the sweep resumes.
+set -u
+PAT='(^|\])\s*(bench|bench_precond)( attempt [0-9]+)?: start$'
+while true; do
+  last=$(tail -1 /tmp/tpu_queue_v4.status 2>/dev/null || true)
+  if echo "$last" | grep -Eq "$PAT"; then
+    pkill -STOP -f train_transformer_lm 2>/dev/null
+    pkill -STOP -f train_wikitext_rnn 2>/dev/null
+  else
+    pkill -CONT -f train_transformer_lm 2>/dev/null
+    pkill -CONT -f train_wikitext_rnn 2>/dev/null
+  fi
+  sleep 15
+done

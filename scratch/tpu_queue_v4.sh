@@ -1,0 +1,82 @@
+#!/bin/bash
+# Round-4 TPU queue, take 2: same phases as v3 but wrapped in an OUTER loop
+# so a phase that exhausted its attempts while the backend was dead gets
+# retried in priority order when the backend returns — v3 failed its
+# headline-bench phase permanently at ~10:33 after a 5h relay outage, which
+# would have wasted a late backend recovery on the low-priority phases.
+#
+# Discipline unchanged (.claude/skills/verify/SKILL.md): ONE TPU process at
+# a time; probe processes of a DEAD backend are safe to time out (no lease
+# exists); never kill a live phase.
+set -u
+cd /root/repo
+STATUS=/tmp/tpu_queue_v4.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+wait_backend() {
+  # Short per-cycle probe budget: the outer loop makes retries cheap, so a
+  # failed cycle should hand control back quickly instead of camping 100min.
+  for i in $(seq 1 8); do
+    if timeout 120 python -c "import jax; print(jax.devices()[0])"; then
+      return 0
+    fi
+    echo "backend probe $i failed; sleeping 30s" >&2
+    sleep 30
+  done
+  return 1
+}
+
+run_phase() {
+  name=$1; logf=$2; shift 2
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
+    return 0
+  fi
+  log "$name: waiting for backend"
+  if ! wait_backend 2>> "$logf"; then
+    log "$name: backend unreachable this cycle"; return 1
+  fi
+  log "$name: start"
+  "$@" >> "$logf" 2>&1
+  rc=$?
+  log "$name: rc=$rc"
+  if [ $rc -eq 0 ]; then echo "DONE $name" >> "$STATUS"; return 0; fi
+  return 1
+}
+
+all_done() {
+  for p in flash-hw bench bench_precond cifar-kfac-tpu cifar-sgd-tpu; do
+    grep -q "^DONE $p$" "$STATUS" 2>/dev/null || return 1
+  done
+  return 0
+}
+
+log "queue v4 start"
+for cycle in $(seq 1 200); do
+  log "cycle $cycle"
+
+  run_phase flash-hw /tmp/flash_hw.log \
+    env KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware
+
+  run_phase bench /tmp/bench_r4.log \
+    sh -c 'python bench.py > /tmp/bench_r4.json 2>> /tmp/bench_r4.log'
+
+  run_phase bench_precond /tmp/bench_precond.out \
+    python scratch/bench_precond.py
+
+  run_phase cifar-kfac-tpu /tmp/cifar_kfac_tpu.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+      --precond-precision default --eigen-dtype bf16 \
+      --log-dir logs/cifar10_resnet32_kfac_tpu --checkpoint-dir /tmp/cc_kfac_tpu
+
+  run_phase cifar-sgd-tpu /tmp/cifar_sgd_tpu.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 0 \
+      --log-dir logs/cifar10_resnet32_sgd_tpu --checkpoint-dir /tmp/cc_sgd_tpu
+
+  if all_done; then log "all phases done"; break; fi
+  sleep 120
+done
+log "queue v4 end"
