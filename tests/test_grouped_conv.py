@@ -171,6 +171,44 @@ def _assert_grouped_matches_explicit(method):
     )
 
 
+def test_depthwise_extreme_group_count():
+    """G == C (depthwise): 1 input channel per group, a_side = kh*kw."""
+
+    class _Depthwise(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            y = KFACConv(C, (3, 3), padding="SAME", feature_group_count=C,
+                         name="dw")(x)
+            return KFACDense(3, name="head")(nn.relu(y).mean(axis=(1, 2)))
+
+    m = _Depthwise()
+    x = _x(8)
+    names = capture.discover_layers(m, x)
+    assert capture.group_counts(names) == {"dw": C}
+    vs = m.init(jax.random.PRNGKey(0), x)
+    kfac = KFAC(damping=0.01, layers=names)
+    state = kfac.init(vs["params"])
+    assert state["factors"]["dw#g0"]["A"].shape == (9, 9)
+    assert state["factors"]["dw#g0"]["G"].shape == (1, 1)
+    # one full update runs and returns finite preconditioned grads
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+    _, mut = m.apply({"params": vs["params"], PERTURBATIONS: perts}, x,
+                     mutable=[KFAC_ACTS])
+    grads, gpert = jax.grad(
+        lambda p, q: jnp.mean(m.apply({"params": p, PERTURBATIONS: q}, x) ** 2),
+        argnums=(0, 1),
+    )(vs["params"], perts)
+    new_grads, _ = kfac.update(
+        grads, state,
+        a_contribs=capture.a_contribs(mut[KFAC_ACTS], names),
+        g_factor_stats=capture.g_factors(gpert, names, batch_averaged=True),
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True,
+    )
+    assert np.isfinite(np.asarray(new_grads["dw"]["kernel"])).all()
+
+
 def test_partial_pseudo_layer_set_rejected():
     """Grouped pseudo-layers must be kept as a complete set — a partial
     allowlist would silently mis-derive the output-channel split."""
