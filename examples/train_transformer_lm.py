@@ -72,6 +72,11 @@ def parse_args(argv=None):
                    help="devices on the 'seq' mesh axis (1 = no sequence parallelism)")
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     # K-FAC (same surface as the CNN trainers)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each transformer block in backward "
+                        "(jax.checkpoint): activation memory O(1) in depth, "
+                        "per-block recompute — the HBM lever for long "
+                        "sequences on TPU")
     p.add_argument("--kfac-embedding", action="store_true",
                    help="precondition the token embedding too (diagonal-A "
                         "K-FAC; beyond the reference's Linear/Conv2d set)")
@@ -143,7 +148,7 @@ def main(argv=None):
     model = transformer_lm.get_model(
         vocab, max_len=args.seq_len, d_model=args.d_model,
         n_heads=args.n_heads, n_layers=args.n_layers, attention_fn=attn,
-        kfac_embedding=args.kfac_embedding,
+        kfac_embedding=args.kfac_embedding, remat=args.remat,
     )
     init_toks = jnp.zeros((global_bs, args.seq_len), jnp.int32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_toks, train=True)

@@ -78,6 +78,13 @@ class TransformerLM(nn.Module):
     # they act as per-position biases and their "input distribution" is a
     # constant arange.
     kfac_embedding: bool = False
+    # Rematerialize each block in the backward pass (jax.checkpoint via
+    # nn.remat): residual activation memory drops from O(n_layers · B·T·D)
+    # to O(B·T·D) + per-block recompute — the standard HBM↔FLOPs trade for
+    # long sequences on TPU. Param tree, gradients, and the K-FAC capture
+    # collections are unchanged (sow re-runs with overwrite semantics in the
+    # recomputed forward; verified in tests/test_transformer_lm.py).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -95,15 +102,19 @@ class TransformerLM(nn.Module):
             jnp.arange(t)[None, :]
         )
         x = x + pos
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if self.remat else TransformerBlock
+        )
         for i in range(self.n_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 d_ff=self.d_ff or 4 * self.d_model,
                 attention_fn=self.attention_fn,
                 dropout=self.dropout,
                 name=f"block_{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(name="ln_f")(x)
         return KFACDense(self.vocab_size, name="decoder")(x)
 
@@ -117,6 +128,7 @@ def get_model(
     attention_fn: AttentionFn = full_attention,
     dropout: float = 0.0,
     kfac_embedding: bool = False,
+    remat: bool = False,
 ) -> TransformerLM:
     """Factory in the style of the other zoos (models/__init__.py)."""
     return TransformerLM(
@@ -124,4 +136,5 @@ def get_model(
         n_heads=n_heads, n_layers=n_layers, attention_fn=attention_fn,
         dropout=dropout,
         kfac_embedding=kfac_embedding,
+        remat=remat,
     )

@@ -61,6 +61,38 @@ def test_kfac_training_decreases_loss():
     assert np.isfinite(losses[-1])
 
 
+def test_remat_is_numerically_transparent():
+    """--remat must change memory, not math: identical param tree, identical
+    full K-FAC train step (grads AND captured factor stats feed the same
+    update), to float tolerance."""
+    kw = dict(d_model=32, n_heads=2, n_layers=2)
+    plain = transformer_lm.get_model(VOCAB, **kw)
+    remat = transformer_lm.get_model(VOCAB, remat=True, **kw)
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+    state_p, tx = _setup(plain, kfac)
+    batch = _batch()
+    step_p = make_train_step(plain, tx, kfac, train_kwargs={"train": True})
+    step_r = make_train_step(remat, tx, kfac, train_kwargs={"train": True})
+    # same initial state for both (steps donate, so build twice)
+    state_r, _ = _setup(remat, kfac)
+    for a, b in zip(jax.tree_util.tree_leaves(state_p.params),
+                    jax.tree_util.tree_leaves(state_r.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+    for _ in range(2):
+        state_p, mp = step_p(state_p, batch, jnp.float32(0.1),
+                             jnp.float32(0.01), update_factors=True,
+                             update_eigen=True)
+        state_r, mr = step_r(state_r, batch, jnp.float32(0.1),
+                             jnp.float32(0.01), update_factors=True,
+                             update_eigen=True)
+    np.testing.assert_allclose(float(mp["loss"]), float(mr["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_p.params),
+                    jax.tree_util.tree_leaves(state_r.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_kfac_sharded_eigen_on_2d_mesh_matches_replicated():
     """On a data×seq mesh, eigen work shards over the 'data' axis only —
     owners must span exactly axis_index('data')'s range, or some layers'
