@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-4 TPU queue, take 3: ONE continuous backend probe loop per cycle —
+# the moment the backend answers, the pending phases run in priority order.
+# (v4 gave each phase its own 20-min probe window, so a recovery during a
+# low-priority phase's window still delayed the headline bench by most of a
+# cycle.) Probe processes of a dead backend are safe to time out; a live
+# phase is never killed.
+set -u
+cd /root/repo
+STATUS=/tmp/tpu_queue_v5.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+backend_up() { timeout 120 python -c "import jax; print(jax.devices()[0])"; }
+
+run_phase() {
+  name=$1; logf=$2; shift 2
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
+    return 0
+  fi
+  log "$name: start"
+  "$@" >> "$logf" 2>&1
+  rc=$?
+  log "$name: rc=$rc"
+  if [ $rc -eq 0 ]; then echo "DONE $name" >> "$STATUS"; return 0; fi
+  return 1
+}
+
+all_done() {
+  for p in flash-hw bench bench_precond cifar-kfac-tpu cifar-sgd-tpu; do
+    grep -q "^DONE $p$" "$STATUS" 2>/dev/null || return 1
+  done
+  return 0
+}
+
+log "queue v5 start"
+for cycle in $(seq 1 500); do
+  log "cycle $cycle: probing for backend"
+  until backend_up 2>/dev/null; do
+    sleep 30
+  done
+  log "cycle $cycle: backend up"
+
+  run_phase flash-hw /tmp/flash_hw.log \
+    env KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware
+
+  run_phase bench /tmp/bench_r4.log \
+    sh -c 'python bench.py > /tmp/bench_r4.json 2>> /tmp/bench_r4.log'
+
+  run_phase bench_precond /tmp/bench_precond.out \
+    python scratch/bench_precond.py
+
+  run_phase cifar-kfac-tpu /tmp/cifar_kfac_tpu.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+      --precond-precision default --eigen-dtype bf16 \
+      --log-dir logs/cifar10_resnet32_kfac_tpu --checkpoint-dir /tmp/cc_kfac_tpu
+
+  run_phase cifar-sgd-tpu /tmp/cifar_sgd_tpu.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 0 \
+      --log-dir logs/cifar10_resnet32_sgd_tpu --checkpoint-dir /tmp/cc_sgd_tpu
+
+  if all_done; then log "all phases done"; break; fi
+  sleep 120
+done
+log "queue v5 end"
