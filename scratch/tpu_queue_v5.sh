@@ -17,6 +17,12 @@ run_phase() {
   if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
     return 0
   fi
+  # the backend can die mid-cycle; a phase launched into a dead backend can
+  # hang un-killably (TPU-init hangs are the known failure mode here), so
+  # re-probe before every launch — cheap when alive, bounded when dead
+  if ! backend_up >/dev/null 2>&1; then
+    log "$name: backend down, deferring to next cycle"; return 1
+  fi
   log "$name: start"
   "$@" >> "$logf" 2>&1
   rc=$?
@@ -34,6 +40,7 @@ all_done() {
 
 log "queue v5 start"
 for cycle in $(seq 1 500); do
+  if all_done; then log "all phases done"; break; fi
   log "cycle $cycle: probing for backend"
   until backend_up 2>/dev/null; do
     sleep 30
