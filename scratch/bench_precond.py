@@ -20,7 +20,7 @@ for _i in range(40):
 
 # ResNet-50 (g=out, a=in(+1 for fc bias)) factor-space shapes
 shapes = []
-shapes.append((64, 148))           # conv1 7x7x3 (与bias? conv no bias) -> 147
+shapes.append((64, 148))           # conv1 7x7x3 +1 pad col -> 148 (conv has no bias; shape-bucket alignment)
 shapes += [(64, 64), (64, 576), (256, 64), (256, 64)]          # layer1 block1 (+downsample)
 shapes += [(64, 256), (64, 576), (256, 64)] * 2                # layer1 blocks 2-3
 shapes += [(128, 256), (128, 1152), (512, 128), (512, 256)]    # layer2 block1
