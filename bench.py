@@ -246,6 +246,15 @@ def _timeit(step, state, warmup=2, iters=20, windows=3, label=""):
     return float(np.mean(times)), float(np.std(times)), state
 
 
+def _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq):
+    """Schedule-weighted mean step time: plain steps + 1/fac factor updates
+    (of which 1/kfac also eigendecompose). Shared by the resnet and LM arms
+    so the amortization model cannot silently diverge between them."""
+    f_full = 1.0 / kfac_freq
+    f_fac = 1.0 / fac_freq - f_full
+    return (1.0 - f_fac - f_full) * t_plain + f_fac * t_fac + f_full * t_full
+
+
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
                  kfac_kwargs=None, sgd_time=None, rec=None):
     """Measure SGD + the three K-FAC step variants for one configuration.
@@ -336,14 +345,19 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         file=sys.stderr,
     )
 
-    f_full = 1.0 / kfac_freq
-    f_fac = 1.0 / fac_freq - f_full
-    f_plain = 1.0 - f_fac - f_full
-    t_amort = f_plain * t_plain + f_fac * t_fac + f_full * t_full
+    t_amort = _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq)
     overhead_pct = (t_amort - t_sgd) / t_sgd * 100.0
+    # the reference's OTHER published ImageNet schedule (its install docs
+    # run cov-freq 200 / kfac-freq 2000): same three timings, different
+    # amortization weights — zero extra chip time for a second datapoint.
+    # docs/flops_r4_*.json shows why it matters: the 10-step factor cadence
+    # alone carries a ~21% FLOP floor at any batch size.
+    t_alt = _amortized(t_plain, t_fac, t_full, 200, 2000)
+    overhead_alt_pct = (t_alt - t_sgd) / t_sgd * 100.0
     print(
         f"amortized kfac{tag} step: {t_amort*1e3:.2f} ms → overhead "
-        f"{overhead_pct:.1f}% (target <25%)",
+        f"{overhead_pct:.1f}% (target <25%); alt schedule f200/e2000: "
+        f"{overhead_alt_pct:.1f}%",
         file=sys.stderr,
     )
     rec.update(
@@ -352,6 +366,7 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         kfac_amortized_ms=round(t_amort * 1e3, 3),
         kfac_img_per_s_chip=round(batch / t_amort, 1),
         overhead_pct=round(overhead_pct, 2),
+        overhead_alt_schedule_f200_e2000_pct=round(overhead_alt_pct, 2),
     )
     return rec
 
@@ -429,9 +444,7 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
     t_full, sd_full, s_kfac = _timeit(
         run_kfac(True, True), s_kfac, warmup=1, iters=3, windows=2,
         label=f"lm-{attn_name} kfac +eigen")
-    f_full = 1.0 / kfac_freq
-    f_fac = 1.0 / fac_freq - f_full
-    t_amort = (1.0 - f_fac - f_full) * t_plain + f_fac * t_fac + f_full * t_full
+    t_amort = _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq)
     overhead_pct = (t_amort - t_sgd) / t_sgd * 100.0
     print(
         f"lm-{attn_name}: sgd {t_sgd*1e3:.2f} ms, kfac amortized "

@@ -1,0 +1,126 @@
+"""Hardware-independent step-cost table: XLA cost analysis per step variant.
+
+Compiles the four train-step variants of the headline bench config
+(ResNet-50, batch 32, 224x224, reference ImageNet schedule) and records the
+compiler's FLOPs and bytes-accessed for each, plus the schedule-amortized
+K-FAC overhead in FLOP terms. This is a LOWER BOUND on achievable time
+overhead at equal FLOP/s efficiency — the wall-clock number on the chip is
+the real metric (bench.py); this table says how much of it is fundamental
+arithmetic vs implementation.
+
+Caveat from docs/precond_scaling_cpu_r4.json: cost_analysis statically sums
+both branches of lax.cond — irrelevant here (the replicated single-device
+step has no owner conditionals).
+
+Writes one JSON line per variant + a summary line. CPU-safe (compile only,
+nothing executed).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+from kfac_pytorch_tpu.platform_override import force_cpu_devices
+
+assert force_cpu_devices(1), "backend already initialized"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models import imagenet_resnet
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+BATCH = int(os.environ.get("KFAC_FLOPS_BATCH", "32"))
+SIZE = 224
+FAC_FREQ, KFAC_FREQ = 10, 100  # reference ImageNet slurm schedule
+# the reference's documented alternate ImageNet recipe
+# (docs/TACC_Install_Instructions/longhorn_gpu_install.md:33)
+ALT_FAC, ALT_KFAC = 200, 2000
+
+
+def _cost(fn, *args):
+    compiled = jax.jit(fn).lower(*args).compile()
+    c = compiled.cost_analysis()
+    c = c[0] if isinstance(c, (list, tuple)) else c
+    return float(c.get("flops", float("nan"))), float(
+        c.get("bytes accessed", float("nan"))
+    )
+
+
+def main(arms):
+    model = imagenet_resnet.get_model("resnet50")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(BATCH, SIZE, SIZE, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=BATCH).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros_like(images), train=True)
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    tx = make_sgd(momentum=0.9, weight_decay=5e-5)
+
+    out = {}
+    for tag, kw in arms.items():
+        kfac = None
+        if kw is not None:
+            kfac = KFAC(damping=0.001, fac_update_freq=FAC_FREQ,
+                        kfac_update_freq=KFAC_FREQ, **kw)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            batch_stats=batch_stats, opt_state=tx.init(params),
+            kfac_state=kfac.init(params) if kfac else None,
+        )
+        step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+        lr, damp = jnp.float32(0.1), jnp.float32(0.001)
+
+        variants = {"sgd": {}} if kfac is None else {
+            "precond": dict(update_factors=False, update_eigen=False),
+            "factors": dict(update_factors=True, update_eigen=False),
+            "eigen": dict(update_factors=True, update_eigen=True),
+        }
+        for vname, flags in variants.items():
+            f, b = _cost(
+                lambda s, bt, l, d, fl=flags: step(s, bt, l, d, **fl),
+                state, (images, labels), lr, damp,
+            )
+            rec = {"arm": tag, "variant": vname,
+                   "gflops": round(f / 1e9, 3), "gbytes": round(b / 1e9, 3)}
+            out[(tag, vname)] = rec
+            print(json.dumps(rec), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    arms = {
+        "sgd": None,
+        "eigen_f32": {},
+        "inverse_aggr": dict(precond_method="inverse",
+                             precond_precision=lax.Precision.DEFAULT,
+                             eigen_dtype=jnp.bfloat16),
+    }
+    out = main(arms)
+    sgd = out[("sgd", "sgd")]["gflops"]
+    summary = {"batch": BATCH, "sgd_gflops": sgd}
+
+    def _amort(fp, ff, fe, fac, kfac):
+        f_e = 1.0 / kfac
+        f_f = 1.0 / fac - f_e
+        return (1 - f_f - f_e) * fp + f_f * ff + f_e * fe
+
+    for tag in ("eigen_f32", "inverse_aggr"):
+        fp = out[(tag, "precond")]["gflops"]
+        ff = out[(tag, "factors")]["gflops"]
+        fe = out[(tag, "eigen")]["gflops"]
+        amort = _amort(fp, ff, fe, FAC_FREQ, KFAC_FREQ)
+        alt = _amort(fp, ff, fe, ALT_FAC, ALT_KFAC)
+        summary[tag] = {
+            "precond_gflops": fp, "factors_gflops": ff, "eigen_gflops": fe,
+            "amortized_gflops": round(amort, 3),
+            "flop_overhead_pct": round((amort - sgd) / sgd * 100.0, 2),
+            "alt_schedule_fac200_kfac2000": {
+                "amortized_gflops": round(alt, 3),
+                "flop_overhead_pct": round((alt - sgd) / sgd * 100.0, 2),
+            },
+        }
+    print(json.dumps(summary), flush=True)
