@@ -61,6 +61,23 @@ def test_kfac_training_decreases_loss():
     assert np.isfinite(losses[-1])
 
 
+def test_remat_actually_inserts_checkpoints():
+    """Guard the wiring: the remat flag must put one checkpointed region per
+    block into the backward jaxpr (a silent no-op would still pass the
+    numerical-transparency test below, since remat is semantics-preserving)."""
+    n_layers = 2
+    model = transformer_lm.get_model(VOCAB, d_model=32, n_heads=2,
+                                     n_layers=n_layers, remat=True)
+    tokens, _ = _batch()
+    vs = model.init(jax.random.PRNGKey(0), tokens, train=True)
+
+    def loss(p):
+        return jnp.mean(model.apply({"params": p}, tokens) ** 2)
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(vs["params"]))
+    assert jaxpr.count("remat") == n_layers
+
+
 def test_remat_is_numerically_transparent():
     """--remat must change memory, not math: identical param tree, identical
     full K-FAC train step (grads AND captured factor stats feed the same
