@@ -158,6 +158,14 @@ def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
     contribution.
     """
     counts = group_counts(names)
+    # one pass over names (not one per grouped entry — that was O(N^2) at
+    # trace time, ~500k split calls for ResNeXt-50's 512 pseudo-layers):
+    # how many pseudo-entries of each grouped base the layer list carries
+    present_counts: Dict[str, int] = {}
+    for n in names:
+        b, g = split_group_name(n)
+        if g is not None:
+            present_counts[b] = present_counts.get(b, 0) + 1
     out = {}
     for name in names:
         base, gi = split_group_name(name)
@@ -166,16 +174,27 @@ def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
         if isinstance(leaf, tuple):
             leaf = leaf[-1]
         if gi is None:
+            if len(getattr(leaf, "shape", ())) == 3:
+                # a stacked [G, a, a] contribution reached a non-expanded
+                # name: KFAC was built with a plain layer list (e.g.
+                # layers=None falling back to param paths) on a grouped
+                # model — broadcasting the stack into the [a, a] running
+                # average would corrupt factor state and surface later as
+                # an opaque shape error
+                raise ValueError(
+                    f"layer {base!r} is a grouped conv (its A-contribution "
+                    f"is a [{leaf.shape[0]}, a, a] stack) but was named "
+                    "without group expansion; build KFAC with "
+                    "layers=capture.discover_layers(model, ...) so grouped "
+                    f"layers expand into '{GROUP_SEP}K' pseudo-layers"
+                )
             out[name] = leaf
             continue
         # The sown [G, a, a] stack is the ground truth for G — enforce the
         # contract that a grouped layer's pseudo-entries are kept/dropped as
         # a COMPLETE set (a partial set would silently mis-derive the
         # output-channel split everywhere group_counts is used).
-        present = sum(
-            1 for n in names if split_group_name(n)[0] == base
-            and split_group_name(n)[1] is not None
-        )
+        present = present_counts[base]
         if counts[base] != leaf.shape[0] or present != leaf.shape[0]:
             raise ValueError(
                 f"grouped layer {base!r}: layer list carries {present} "

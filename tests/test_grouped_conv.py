@@ -227,6 +227,25 @@ def test_partial_pseudo_layer_set_rejected():
             capture.a_contribs(mut[KFAC_ACTS], partial)
 
 
+def test_unexpanded_grouped_name_rejected():
+    """A grouped layer named WITHOUT pseudo-layer expansion (e.g. KFAC built
+    from raw param paths instead of capture.discover_layers) must fail with
+    the discover_layers hint, not corrupt factor state by broadcasting the
+    stacked [G, a, a] contribution into an [a, a] running average."""
+    import pytest
+
+    m = _Grouped()
+    x = _x(7)
+    vs = m.init(jax.random.PRNGKey(0), x)
+    perts = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), vs[PERTURBATIONS]
+    )
+    _, mut = m.apply({"params": vs["params"], PERTURBATIONS: perts}, x,
+                     mutable=[KFAC_ACTS])
+    with pytest.raises(ValueError, match="discover_layers"):
+        capture.a_contribs(mut[KFAC_ACTS], ["gc", "head"])
+
+
 def test_grouped_kfac_matches_explicit_groups_eigen():
     _assert_grouped_matches_explicit("eigen")
 
