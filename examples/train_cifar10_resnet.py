@@ -54,6 +54,20 @@ def parse_args(argv=None):
     )
     p.add_argument("--data-dir", default=None, help="CIFAR-10 data dir")
     p.add_argument("--synthetic", action="store_true", help="use synthetic data")
+    # knobs for the learnable stand-in (used when no CIFAR-10 is on disk):
+    # the published convergence twins pin these so the task has a real
+    # accuracy ceiling and post-decay epochs stay discriminative
+    p.add_argument("--synth-classes", type=int, default=10,
+                   help="stand-in class count (also sizes the model head)")
+    p.add_argument("--synth-prototypes", type=int, default=10,
+                   help="stand-in prototypes per class")
+    p.add_argument("--synth-noise", type=float, default=0.55,
+                   help="stand-in additive pixel noise sigma")
+    p.add_argument("--synth-label-noise", type=float, default=0.08,
+                   help="stand-in TRAIN label flip fraction")
+    p.add_argument("--synth-val-label-noise", type=float, default=0.0,
+                   help="stand-in VAL label flip fraction f (flips always "
+                        "land wrong: hard accuracy ceiling of exactly 1-f)")
     p.add_argument("--log-dir", default="./logs", help="TensorBoard/JSONL log dir")
     p.add_argument("--checkpoint-dir", default=None, help="checkpoint dir (enables save/resume)")
     p.add_argument("--model", default="resnet32", help="cifar resnet variant")
@@ -161,7 +175,8 @@ def main(argv=None):
         )
 
     model = cifar_resnet.get_model(
-        args.model, dtype=jnp.bfloat16 if args.bf16 else None
+        args.model, dtype=jnp.bfloat16 if args.bf16 else None,
+        num_classes=args.synth_classes,
     )
     init_images = jnp.zeros((global_bs, 32, 32, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_images, train=True)
@@ -287,6 +302,15 @@ def main(argv=None):
     if cifar_dir and not all_have_data:
         print(f"host {launch.rank()}: data found but other hosts lack it; using stand-in data")
         cifar_dir = None
+    # checked only AFTER the host-agreed fallback above: cifar_dir is now
+    # identical on every host, so this SystemExit fires uniformly instead of
+    # desyncing a pod where only some hosts have the data on disk
+    if cifar_dir and args.synth_classes != 10:
+        raise SystemExit(
+            "--synth-classes only applies to the learnable stand-in, but "
+            "real CIFAR-10 (10 classes) was found on disk; drop the flag or "
+            "the data"
+        )
     train_loader = None
     x_train = x_val = None
     if cifar_dir:
@@ -299,7 +323,12 @@ def main(argv=None):
         # epoch) remain meaningful; --synthetic keeps the pure-noise
         # benchmark pipeline
         (x_train, y_train), (x_val, y_val) = data_lib.synthetic_cifar_like(
-            seed=args.seed
+            num_classes=args.synth_classes,
+            prototypes_per_class=args.synth_prototypes,
+            noise=args.synth_noise,
+            label_noise=args.synth_label_noise,
+            val_label_noise=args.synth_val_label_noise,
+            seed=args.seed,
         )
         source = "synthetic-learnable stand-in (no CIFAR-10 on this image)"
     if x_train is not None:
@@ -334,7 +363,8 @@ def main(argv=None):
             )
         else:
             batches = data_lib.synthetic_batches(
-                local_bs * accum, (32, 32, 3), 10, steps_per_epoch, seed=args.seed
+                local_bs * accum, (32, 32, 3), args.synth_classes,
+                steps_per_epoch, seed=args.seed
             )
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")

@@ -42,3 +42,38 @@ def test_shapes_and_norm():
     assert x.shape == (2000, 32, 32, 3) and v.shape == (500, 32, 32, 3)
     assert x.dtype == np.float32 and y.dtype == np.int32
     assert np.isfinite(x).all()
+
+
+def test_val_label_noise_caps_ceiling():
+    """val_label_noise flips the requested fraction of VAL labels only —
+    the hard accuracy ceiling the round-5 hardened twins train against."""
+    (xc, yc), (vc, wc) = _gen(val_label_noise=0.0)
+    (xn, yn), (vn, wn) = _gen(val_label_noise=0.06)
+    np.testing.assert_array_equal(xc, xn)  # images untouched
+    np.testing.assert_array_equal(vc, vn)
+    np.testing.assert_array_equal(yc, yn)  # train labels untouched
+    rate = (wc != wn).mean()
+    assert 0.03 < rate < 0.09, rate
+    assert wn.min() >= 0 and wn.max() < 10
+
+
+def test_imagenet_like_shards():
+    """The ImageNet-class stand-in: uint8 pipeline shards, deterministic,
+    learnable class structure (per-class mean separation), val clean."""
+    (x, y), (v, w) = data_lib.synthetic_imagenet_like(
+        num_classes=8, size=32, n_train=600, n_val=150,
+        prototypes_per_class=2, seed=3,
+    )
+    assert x.shape == (600, 32, 32, 3) and x.dtype == np.uint8
+    assert v.shape == (150, 32, 32, 3) and w.dtype == np.int32
+    assert y.min() >= 0 and y.max() < 8
+    (x2, y2), _ = data_lib.synthetic_imagenet_like(
+        num_classes=8, size=32, n_train=600, n_val=150,
+        prototypes_per_class=2, seed=3,
+    )
+    np.testing.assert_array_equal(x, x2)
+    np.testing.assert_array_equal(y, y2)
+    # class signal survives quantization: between-class spread of the
+    # per-class mean pixel dwarfs what label-independent noise would give
+    means = np.array([x[y == c].mean() for c in range(8)])
+    assert means.std() > 0.5, means.std()
