@@ -231,6 +231,11 @@ def _timeit(step, state, warmup=2, iters=20, windows=3, label=""):
     vs the round-3 pipelined profile). ``windows`` repeat measurements give
     a spread for the JSON detail.
     """
+    # KFAC_BENCH_ITERS_SCALE shrinks every timing loop uniformly — the CPU
+    # fallback table (docs/wallclock_cpu_r5.json) needs ~seconds-long steps
+    # to stay inside a wall budget; hardware runs leave it at 1.
+    scale = float(os.environ.get("KFAC_BENCH_ITERS_SCALE", "1"))
+    iters = max(1, int(round(iters * scale)))
     _log(f"{label}: compiling/warmup ...")
     for _ in range(warmup):
         state = step(state)
@@ -469,16 +474,21 @@ def _transformer_bench(fac_freq, kfac_freq):
     from kfac_pytorch_tpu.parallel.context import full_attention
 
     batch, seq = 4, 2048
+    lm_kw = {}
     if os.environ.get("KFAC_BENCH_SMALL"):  # CPU smoke-test sizes
         batch, seq = 2, 128
+        lm_kw = dict(d_model=64, n_heads=4, n_layers=2, vocab=256)
+    if os.environ.get("KFAC_BENCH_LM_CFG"):
+        # "batch,seq,d_model,n_heads,n_layers,vocab" — the CPU fallback
+        # record (docs/) needs mid-sized shapes: big enough that the K-FAC
+        # tax is real work, small enough for a 1-core box
+        b, s, dm, nh, nl, vo = map(int, os.environ["KFAC_BENCH_LM_CFG"].split(","))
+        batch, seq = b, s
+        lm_kw = dict(d_model=dm, n_heads=nh, n_layers=nl, vocab=vo)
     sub_arms = [
         ("naive-kfac", full_attention, False),
         ("flash-kfac", best_attention_fn(), False),
     ]
-    lm_kw = (
-        dict(d_model=64, n_heads=4, n_layers=2, vocab=256)
-        if os.environ.get("KFAC_BENCH_SMALL") else {}
-    )
     for name, fn, sgd_only in sub_arms:
         try:
             _LM_ARMS[name] = _measure_lm_arm(

@@ -35,7 +35,7 @@ from kfac_pytorch_tpu.models import imagenet_resnet
 from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
 
 BATCH = int(os.environ.get("KFAC_FLOPS_BATCH", "32"))
-SIZE = 224
+SIZE = int(os.environ.get("KFAC_FLOPS_SIZE", "224"))
 FAC_FREQ, KFAC_FREQ = 10, 100  # reference ImageNet slurm schedule
 # the reference's documented alternate ImageNet recipe
 # (docs/TACC_Install_Instructions/longhorn_gpu_install.md:33)
@@ -101,7 +101,7 @@ if __name__ == "__main__":
     }
     out = main(arms)
     sgd = out[("sgd", "sgd")]["gflops"]
-    summary = {"batch": BATCH, "sgd_gflops": sgd}
+    summary = {"batch": BATCH, "image_size": SIZE, "sgd_gflops": sgd}
 
     def _amort(fp, ff, fe, fac, kfac):
         f_e = 1.0 / kfac
