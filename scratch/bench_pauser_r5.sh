@@ -1,0 +1,20 @@
+#!/bin/bash
+# Timing purity on the 1-core box: SIGSTOP the CPU-side work queue while a
+# TPU *timing* phase is actively measuring (pipelined windows are host-
+# dispatch sensitive), SIGCONT otherwise. Convergence phases tolerate a busy
+# core; only bench/bench_precond/precond-dist need it quiet.
+set -u
+PAT='(^|\])\s*(bench|bench_precond|precond-dist)( attempt [0-9]+)?: start$'
+# NB: the TPU bench itself is `python bench.py`; the CPU wallclock run goes
+# through scratch/wallclock_cpu_r5.py precisely so these patterns can't
+# stop the hardware bench.
+CPU_PATS="train_transformer_lm train_wikitext_rnn train_cifar10_resnet train_imagenet_resnet wallclock_cpu_r5"
+while true; do
+  last=$(tail -1 /root/repo/docs/tpu_queue_r5.status 2>/dev/null || true)
+  if echo "$last" | grep -Eq "$PAT"; then
+    for p in $CPU_PATS; do pkill -STOP -f "$p" 2>/dev/null; done
+  else
+    for p in $CPU_PATS; do pkill -CONT -f "$p" 2>/dev/null; done
+  fi
+  sleep 15
+done

@@ -1,0 +1,109 @@
+"""Micro-bench: the distribute_precondition exchange on real hardware.
+
+VERDICT r4 next-round #6: the pod-scale claim for ``distribute_precondition``
+(docs/PERF.md:104-109) rests on an unmeasured assumption that XLA overlaps
+the ~102 MB result psum with compute. This times ONE precond-only train step
+with and without ``distribute_precondition`` (and with bf16 precond comm) on
+a mesh over every available device, ResNet-50 shapes, and prints one JSON
+record. At world=1 the psum is a no-op and the record says so — the point is
+to have the measurement armed for whenever the relay offers >1 chip.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from kfac_pytorch_tpu.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+for _i in range(40):  # wait out a wedged TPU lease
+    try:
+        jax.devices()
+        break
+    except RuntimeError as e:
+        log(f"TPU unavailable ({str(e)[:80]}); retry {_i}")
+        time.sleep(30)
+
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC, capture
+from kfac_pytorch_tpu.models import imagenet_resnet
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+mesh = data_parallel_mesh()
+world = mesh.devices.size
+batch, size = 32 * world, int(os.environ.get("KFAC_PD_IMAGE", "64"))
+log(f"world={world} global_batch={batch} image={size}")
+
+model = imagenet_resnet.get_model("resnet50")
+rng = np.random.RandomState(0)
+images = rng.randn(batch, size, size, 3).astype(np.float32)
+labels = rng.randint(0, 1000, size=batch).astype(np.int32)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros_like(jnp.asarray(images)), train=True)
+params, batch_stats = variables["params"], variables.get("batch_stats", {})
+tx = make_sgd(momentum=0.9, weight_decay=5e-5)
+xb, yb = put_global_batch(mesh, (images, labels))
+lr, damping = jnp.float32(0.1), jnp.float32(0.001)
+
+
+def measure(tag, **kfac_kwargs):
+    kfac = KFAC(damping=0.001, fac_update_freq=10, kfac_update_freq=100,
+                mesh=mesh if world > 1 else None, **kfac_kwargs)
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=jax.tree_util.tree_map(jnp.copy, params),
+        batch_stats=jax.tree_util.tree_map(jnp.copy, batch_stats),
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    log(f"{tag}: compiling (factors+eigen once, then precond-only) ...")
+    state, _ = step(state, (xb, yb), lr, damping,
+                    update_factors=True, update_eigen=True)
+
+    def precond_only(s):
+        s2, _ = step(s, (xb, yb), lr, damping,
+                     update_factors=False, update_eigen=False)
+        return s2
+
+    state = precond_only(state)
+    state = jax.block_until_ready(state)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state = precond_only(state)
+        state = jax.block_until_ready(state)
+        times.append((time.perf_counter() - t0) / 10)
+    ms = float(np.mean(times)) * 1e3
+    log(f"{tag}: {ms:.3f} ms/step (std {np.std(times)*1e3:.3f})")
+    return round(ms, 3)
+
+
+res = {
+    "world": world,
+    "global_batch": batch,
+    "image": size,
+    "note": ("world=1: result-psum is a no-op; this record is the armed "
+             "measurement, not pod evidence") if world == 1 else
+            "ratio dist/replicated isolates the exchange cost on this mesh",
+}
+res["replicated_ms"] = measure("replicated")
+res["distributed_ms"] = measure("distributed", distribute_precondition=True)
+res["distributed_bf16comm_ms"] = measure(
+    "distributed+bf16comm", distribute_precondition=True,
+    precond_comm_dtype=jnp.bfloat16)
+print(json.dumps(res))

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 CIFAR convergence twins (VERDICT r4 next-round #3): the r4 recipe
+# re-based with (a) --bn-recal-batches 20 ON — the committed curves must
+# demonstrate the BN fix the README advertises, not just a unit test — and
+# (b) a stand-in hardened to a REAL accuracy ceiling so post-decay epochs
+# discriminate: 20 classes x 16 prototypes, 0.8 pixel noise, 8% train label
+# noise, 4% VAL label noise (flips always land wrong → hard ceiling exactly
+# 96%, with the images themselves far harder than r4's). Telemetry stays on.
+set -u
+cd /root/repo
+export KFAC_FORCE_PLATFORM=cpu:4
+LOG=docs/cifar_curves_r5.log
+run() {
+  name=$1; shift
+  if [ -f "logs/$name/.done" ]; then
+    echo "[skip] $name (complete)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  rc=$?
+  [ $rc -eq 0 ] && touch "logs/$name/.done"
+  echo "[$(date +%H:%M:%S)] done $name rc=$rc" >> "$LOG"
+}
+
+# r4 recipe otherwise unchanged for comparability: 4-device mesh, per-device
+# batch 16 -> global 64, peak lr 0.4, 5-epoch warmup, decay 13/17, 200
+# steps/epoch, identical data order for both twins.
+CIFAR="python examples/train_cifar10_resnet.py --model resnet32 --batch-size 16 --epochs 20 --lr-decay 13 17 --steps-per-epoch 200 --seed 42 --synth-classes 20 --synth-prototypes 16 --synth-noise 0.8 --synth-label-noise 0.08 --synth-val-label-noise 0.04"
+
+run cifar10_resnet32_kfac_r5 $CIFAR \
+  --kfac-update-freq 10 --kfac-cov-update-freq 10 \
+  --precond-precision default --eigen-dtype bf16 \
+  --bn-recal-batches 20 --kfac-diagnostics
+run cifar10_resnet32_sgd_r5 $CIFAR --kfac-update-freq 0
+
+echo "[$(date +%H:%M:%S)] cifar r5 curves done" >> "$LOG"

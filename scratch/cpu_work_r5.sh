@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-5 CPU work queue: every CPU-side deliverable, SERIALIZED (1-core
+# box — parallel heavy jobs just thrash), in the verdict's priority order.
+# The TPU queue (tpu_queue_v6.sh) runs concurrently but mostly sleeps; the
+# pauser SIGSTOPs these jobs during TPU timing phases.
+#   1. CPU wall-clock arm table        -> docs/wallclock_cpu_r5.json
+#   2. ImageNet-class convergence twins-> logs/imagenet_rn50_{kfac,sgd}_r5
+#   3. re-based hardened CIFAR twins   -> logs/cifar10_resnet32_{kfac,sgd}_r5
+#   4. CPU transformer bench record    -> docs/transformer_bench_cpu_r5.json
+#   5. multi-seed LM sweep             -> logs/*_s{43,44}_r5
+set -u
+cd /root/repo
+STATUS=docs/cpu_work_r5.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+phase() {
+  name=$1; shift
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then return 0; fi
+  log "$name: start"
+  "$@"
+  rc=$?
+  log "$name: rc=$rc"
+  [ $rc -eq 0 ] && echo "DONE $name" >> "$STATUS"
+}
+
+log "cpu work queue r5 start"
+phase flops_im64_b32 sh -c 'KFAC_FLOPS_SIZE=64 KFAC_FLOPS_BATCH=32 python scratch/flops_table.py > docs/flops_r5_im64_b32.json 2>> docs/flops_r5.log'
+phase flops_im64_b128 sh -c 'KFAC_FLOPS_SIZE=64 KFAC_FLOPS_BATCH=128 python scratch/flops_table.py > docs/flops_r5_im64_b128.json 2>> docs/flops_r5.log'
+phase wallclock sh -c 'python scratch/wallclock_cpu_r5.py >> docs/wallclock_cpu_r5.out 2>&1'
+phase imagenet_twins bash scratch/imagenet_curves_r5.sh
+phase cifar_twins bash scratch/cifar_curves_r5.sh
+phase transformer_bench sh -c 'python scratch/wallclock_cpu_r5_lm.py >> docs/transformer_bench_cpu_r5.out 2>&1'
+phase lm_seeds bash scratch/lm_seeds_r5.sh
+log "cpu work queue r5 done"

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 multi-seed LM evidence (VERDICT r4 next-round #4): the r4 sweep's
+# decisive arms re-run at seeds 43 and 44 (seed 42 is the committed r4 run),
+# so every LM claim carries a 3-seed spread. Same data, flags, step counts
+# as scratch/lm_sweep_r4c.sh.
+set -u
+cd /root/repo
+export KFAC_FORCE_PLATFORM=cpu:1
+LOG=docs/lm_seeds_r5.log
+DATA=/tmp/code-corpus
+run() {
+  name=$1; shift
+  if [ -f "logs/$name/.done" ]; then
+    echo "[skip] $name (complete)" >> "$LOG"; return 0
+  fi
+  echo "[$(date +%H:%M:%S)] start $name" >> "$LOG"
+  "$@" --log-dir "logs/$name" >> "$LOG" 2>&1
+  rc=$?
+  [ $rc -eq 0 ] && touch "logs/$name/.done"
+  echo "[$(date +%H:%M:%S)] done $name rc=$rc" >> "$LOG"
+}
+
+test -f $DATA/wiki.train.tokens || \
+  python scripts/make_code_corpus.py --out $DATA >> "$LOG" 2>&1
+
+for SEED in 43 44; do
+  TRANS="python examples/train_transformer_lm.py --data-dir $DATA --epochs 4 --d-model 256 --n-layers 2 --seq-len 128 --batch-size 16 --steps-per-epoch 600 --seed $SEED"
+  # transformer pair first: it carries the 4/4-epoch headline claim
+  run transformer_lm_kfac_s${SEED}_r5 $TRANS --kfac-update-freq 10
+  run transformer_lm_sgd_s${SEED}_r5 $TRANS --kfac-update-freq 0
+
+  LSTM="python examples/train_wikitext_rnn.py --data-dir $DATA --epochs 6 --emsize 256 --nhid 256 --steps-per-epoch 1000 --seed $SEED"
+  run wikitext_lstm_kfac_tuned_s${SEED}_r5 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01
+  run wikitext_lstm_sgd_lr5_s${SEED}_r5 $LSTM --kfac-update-freq 0 --base-lr 5
+  run wikitext_lstm_kfac_emb_s${SEED}_r5 $LSTM --kfac-update-freq 10 --base-lr 5 --kl-clip 0.01 --kfac-embedding
+done
+
+echo "[$(date +%H:%M:%S)] lm seeds done" >> "$LOG"

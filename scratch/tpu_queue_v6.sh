@@ -1,0 +1,104 @@
+#!/bin/bash
+# Round-5 TPU queue. Same continuous-probe design as v5 (one probe loop per
+# cycle; phases run in priority order the moment the backend answers), with
+# the round-4 verdict's fixes:
+#   * EVERY phase log lives under the repo (docs/ or logs/), never /tmp —
+#     partial hardware contact must leave committed evidence (VERDICT r4
+#     "What's missing" #3).
+#   * bench is FIRST and its stdout JSON is written straight to
+#     docs/bench_r5.json.
+#   * new phases: precond-dist (the distribute_precondition exchange timing,
+#     VERDICT r4 next-round #6), imagenet twins on the chip (#2), and the
+#     CIFAR twins now run with --bn-recal-batches 20 (#3).
+set -u
+cd /root/repo
+STATUS=docs/tpu_queue_r5.status
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$STATUS"; }
+
+backend_up() { timeout 120 python -c "import jax; print(jax.devices()[0])"; }
+
+run_phase() {
+  name=$1; logf=$2; shift 2
+  if grep -q "^DONE $name$" "$STATUS" 2>/dev/null; then
+    return 0
+  fi
+  # the backend can die mid-cycle; a phase launched into a dead backend can
+  # hang un-killably (TPU-init hangs are the known failure mode here), so
+  # re-probe before every launch — cheap when alive, bounded when dead
+  if ! backend_up >/dev/null 2>&1; then
+    log "$name: backend down, deferring to next cycle"; return 1
+  fi
+  log "$name: start"
+  "$@" >> "$logf" 2>&1
+  rc=$?
+  log "$name: rc=$rc"
+  if [ $rc -eq 0 ]; then echo "DONE $name" >> "$STATUS"; return 0; fi
+  return 1
+}
+
+PHASES="bench flash-hw bench_precond precond-dist imagenet-kfac-tpu imagenet-sgd-tpu cifar-kfac-tpu cifar-sgd-tpu"
+all_done() {
+  for p in $PHASES; do
+    grep -q "^DONE $p$" "$STATUS" 2>/dev/null || return 1
+  done
+  return 0
+}
+
+log "queue v6 start"
+for cycle in $(seq 1 500); do
+  if all_done; then log "all phases done"; break; fi
+  log "cycle $cycle: probing for backend"
+  until backend_up 2>/dev/null; do
+    sleep 60
+  done
+  log "cycle $cycle: backend up"
+
+  run_phase bench docs/bench_r5.log \
+    sh -c 'KFAC_BENCH_WALL_S=3300 python bench.py > docs/bench_r5.json 2>> docs/bench_r5.log'
+
+  run_phase flash-hw docs/flash_hw_r5.txt \
+    env KFAC_TEST_TPU=1 python -m pytest tests/test_flash_attention.py -q -k tpu_hardware
+
+  run_phase bench_precond docs/bench_precond_r5.log \
+    sh -c 'python scratch/bench_precond.py > docs/bench_precond_r5.json 2>> docs/bench_precond_r5.log'
+
+  run_phase precond-dist docs/precond_dist_r5.log \
+    sh -c 'python scratch/bench_precond_dist.py > docs/precond_dist_r5.json 2>> docs/precond_dist_r5.log'
+
+  # short ImageNet-class contact run on the chip: synthetic-learnable shards
+  # (scratch/make_synth_imagenet.py populates /tmp/synth-imagenet at queue
+  # start), reference slurm schedule frequencies
+  run_phase imagenet-kfac-tpu logs/imagenet_rn50_kfac_tpu_r5.log \
+    python examples/train_imagenet_resnet.py \
+      --data-dir /tmp/synth-imagenet --model resnet50 \
+      --image-size 64 --val-resize 72 --batch-size 32 --val-batch-size 100 \
+      --epochs 4 --lr-decay 3 --warmup-epochs 1 --steps-per-epoch 300 \
+      --kfac-update-freq 100 --kfac-cov-update-freq 10 \
+      --precond-method inverse --precond-precision default --eigen-dtype bf16 \
+      --log-dir logs/imagenet_rn50_kfac_tpu_r5 --checkpoint-dir /tmp/ck_in_kfac_tpu
+
+  run_phase imagenet-sgd-tpu logs/imagenet_rn50_sgd_tpu_r5.log \
+    python examples/train_imagenet_resnet.py \
+      --data-dir /tmp/synth-imagenet --model resnet50 \
+      --image-size 64 --val-resize 72 --batch-size 32 --val-batch-size 100 \
+      --epochs 4 --lr-decay 3 --warmup-epochs 1 --steps-per-epoch 300 \
+      --kfac-update-freq 0 \
+      --log-dir logs/imagenet_rn50_sgd_tpu_r5 --checkpoint-dir /tmp/ck_in_sgd_tpu
+
+  run_phase cifar-kfac-tpu logs/cifar10_resnet32_kfac_tpu_r5.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 10 --kfac-cov-update-freq 1 \
+      --precond-precision default --eigen-dtype bf16 --bn-recal-batches 20 \
+      --log-dir logs/cifar10_resnet32_kfac_tpu_r5 --checkpoint-dir /tmp/cc_kfac_tpu5
+
+  run_phase cifar-sgd-tpu logs/cifar10_resnet32_sgd_tpu_r5.log \
+    python examples/train_cifar10_resnet.py \
+      --model resnet32 --epochs 12 --lr-decay 8 11 \
+      --kfac-update-freq 0 \
+      --log-dir logs/cifar10_resnet32_sgd_tpu_r5 --checkpoint-dir /tmp/cc_sgd_tpu5
+
+  if all_done; then log "all phases done"; break; fi
+  sleep 120
+done
+log "queue v6 end"
