@@ -27,10 +27,12 @@ run() {
 # steps/epoch, identical data order for both twins.
 CIFAR="python examples/train_cifar10_resnet.py --model resnet32 --batch-size 16 --epochs 20 --lr-decay 13 17 --steps-per-epoch 200 --seed 42 --synth-classes 20 --synth-prototypes 16 --synth-noise 0.8 --synth-label-noise 0.08 --synth-val-label-noise 0.04"
 
+# SGD twin first: a truncated round still leaves the complete baseline +
+# a partial K-FAC curve (scalars stream per epoch)
+run cifar10_resnet32_sgd_r5 $CIFAR --kfac-update-freq 0
 run cifar10_resnet32_kfac_r5 $CIFAR \
   --kfac-update-freq 10 --kfac-cov-update-freq 10 \
   --precond-precision default --eigen-dtype bf16 \
   --bn-recal-batches 20 --kfac-diagnostics
-run cifar10_resnet32_sgd_r5 $CIFAR --kfac-update-freq 0
 
 echo "[$(date +%H:%M:%S)] cifar r5 curves done" >> "$LOG"

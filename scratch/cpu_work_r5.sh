@@ -3,11 +3,13 @@
 # box — parallel heavy jobs just thrash), in the verdict's priority order.
 # The TPU queue (tpu_queue_v6.sh) runs concurrently but mostly sleeps; the
 # pauser SIGSTOPs these jobs during TPU timing phases.
-#   1. CPU wall-clock arm table        -> docs/wallclock_cpu_r5.json
-#   2. ImageNet-class convergence twins-> logs/imagenet_rn50_{kfac,sgd}_r5
-#   3. re-based hardened CIFAR twins   -> logs/cifar10_resnet32_{kfac,sgd}_r5
-#   4. CPU transformer bench record    -> docs/transformer_bench_cpu_r5.json
-#   5. multi-seed LM sweep             -> logs/*_s{43,44}_r5
+#   1. FLOP floors at the CPU table's shape -> docs/flops_r5_im64_b{32,128}.json
+#   2. CPU wall-clock arm table        -> docs/wallclock_cpu_r5.json
+#   3. CPU transformer bench record    -> docs/transformer_bench_cpu_r5.json
+#      (small + a hard r3 carryover: banked before the long twin runs)
+#   4. ImageNet-class convergence twins-> logs/imagenet_rn18_{sgd,kfac}_r5
+#   5. re-based hardened CIFAR twins   -> logs/cifar10_resnet32_{sgd,kfac}_r5
+#   6. multi-seed LM sweep             -> logs/*_s{43,44}_r5
 set -u
 cd /root/repo
 STATUS=docs/cpu_work_r5.status
@@ -27,8 +29,8 @@ log "cpu work queue r5 start"
 phase flops_im64_b32 sh -c 'KFAC_FLOPS_SIZE=64 KFAC_FLOPS_BATCH=32 python scratch/flops_table.py > docs/flops_r5_im64_b32.json 2>> docs/flops_r5.log'
 phase flops_im64_b128 sh -c 'KFAC_FLOPS_SIZE=64 KFAC_FLOPS_BATCH=128 python scratch/flops_table.py > docs/flops_r5_im64_b128.json 2>> docs/flops_r5.log'
 phase wallclock sh -c 'python scratch/wallclock_cpu_r5.py >> docs/wallclock_cpu_r5.out 2>&1'
+phase transformer_bench sh -c 'python scratch/wallclock_cpu_r5_lm.py >> docs/transformer_bench_cpu_r5.out 2>&1'
 phase imagenet_twins bash scratch/imagenet_curves_r5.sh
 phase cifar_twins bash scratch/cifar_curves_r5.sh
-phase transformer_bench sh -c 'python scratch/wallclock_cpu_r5_lm.py >> docs/transformer_bench_cpu_r5.out 2>&1'
 phase lm_seeds bash scratch/lm_seeds_r5.sh
 log "cpu work queue r5 done"
