@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Seed-aggregated twin comparison from committed scalars.jsonl curves.
+
+Round-4 verdict (Weak #4): every LM claim was single-seed. This prints, per
+epoch, each arm's per-seed values plus mean +/- spread (min..max), and the
+mean-vs-mean comparison, so claims can be restated with seed spread.
+
+Usage:
+    python scripts/aggregate_seeds.py --tag val/loss \
+        logs/transformer_lm_kfac_cc_r4 logs/transformer_lm_kfac_s43_r5 \
+        vs logs/transformer_lm_sgd_cc_r4 logs/transformer_lm_sgd_s43_r5
+
+Arms are separated by a literal ``vs`` (argparse eats a bare ``--``); each
+side lists the same arm at different seeds. Output is also emitted as one
+JSON line for committing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load(run_dir: str, tag: str):
+    out = {}
+    with open(os.path.join(run_dir, "scalars.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec["tag"] == tag:
+                out[rec["step"]] = rec["value"]
+    if not out:
+        raise SystemExit(f"tag {tag!r} missing from {run_dir}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", default="val/loss")
+    ap.add_argument("runs", nargs="+")
+    args = ap.parse_args()
+    if "vs" not in args.runs:
+        raise SystemExit("separate the two arms with a literal: vs")
+    cut = args.runs.index("vs")
+    arms = [args.runs[:cut], args.runs[cut + 1:]]
+    if not arms[0] or not arms[1]:
+        raise SystemExit("each arm needs at least one run directory")
+
+    lower_better = "loss" in args.tag or "ppl" in args.tag
+    series = [
+        {os.path.basename(r): load(r, args.tag) for r in arm} for arm in arms
+    ]
+    epochs = sorted(
+        set.intersection(*(set(s) for arm in series for s in arm.values()))
+    )
+    name = [os.path.commonprefix(sorted(s)) or f"arm{i}"
+            for i, s in enumerate(series)]
+    print(f"tag={args.tag}  A={name[0]}({len(series[0])} seeds)  "
+          f"B={name[1]}({len(series[1])} seeds)")
+    rows = []
+    wins = 0
+    for e in epochs:
+        vals = [[s[e] for s in arm.values()] for arm in series]
+        means = [sum(v) / len(v) for v in vals]
+        better = means[0] <= means[1] if lower_better else means[0] >= means[1]
+        wins += better
+        mark = ("<=" if lower_better else ">=") if better else ("> " if lower_better else "< ")
+        print(
+            f"epoch {e:3d}  A {means[0]:8.4f} [{min(vals[0]):.4f}..{max(vals[0]):.4f}]"
+            f"  {mark}  B {means[1]:8.4f} [{min(vals[1]):.4f}..{max(vals[1]):.4f}]"
+        )
+        rows.append({"epoch": e,
+                     "a": {"mean": means[0], "per_seed": vals[0]},
+                     "b": {"mean": means[1], "per_seed": vals[1]}})
+    print(f"mean-vs-mean: A {'<=' if lower_better else '>='} B on "
+          f"{wins}/{len(epochs)} epochs")
+    print(json.dumps({"tag": args.tag, "a": name[0], "b": name[1],
+                      "a_runs": [os.path.basename(r) for r in arms[0]],
+                      "b_runs": [os.path.basename(r) for r in arms[1]],
+                      "wins_a": wins, "epochs": len(epochs), "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
