@@ -31,6 +31,10 @@ phase flops_im64_b128 sh -c 'KFAC_FLOPS_SIZE=64 KFAC_FLOPS_BATCH=128 python scra
 phase wallclock sh -c 'python scratch/wallclock_cpu_r5.py >> docs/wallclock_cpu_r5.out 2>&1'
 phase transformer_bench sh -c 'python scratch/wallclock_cpu_r5_lm.py >> docs/transformer_bench_cpu_r5.out 2>&1'
 phase imagenet_twins bash scratch/imagenet_curves_r5.sh
-phase cifar_twins bash scratch/cifar_curves_r5.sh
+# lm_seeds before cifar: with the ImageNet twin running ~25 min/epoch on
+# this box, the tail phases won't all fit — the multi-seed sweep backs
+# ALREADY-published headline claims (r4 transformer 4/4), so it outranks
+# re-basing curves that exist; both resume from .done sentinels
 phase lm_seeds bash scratch/lm_seeds_r5.sh
+phase cifar_twins bash scratch/cifar_curves_r5.sh
 log "cpu work queue r5 done"
