@@ -17,7 +17,11 @@ import os
 import sys
 
 os.environ.setdefault("KFAC_FORCE_PLATFORM", "cpu:1")
-os.environ.setdefault("KFAC_BENCH_ITERS_SCALE", "0.1")
+# 0.05: the f32 arm's HIGHEST-precision rotations run ~4 min/step on this
+# box (371 GFLOP at ~1.5 GFLOP/s, docs/flops_r5_im64_b32.json) — iters=1-2
+# per window keeps the full arm matrix inside a few hours while windows
+# still give a spread
+os.environ.setdefault("KFAC_BENCH_ITERS_SCALE", "0.05")
 os.environ.setdefault("KFAC_BENCH_WALL_S", "100000")
 os.environ.setdefault("KFAC_BENCH_SKIP_TRANSFORMER", "1")
 # shape concession for the 1-core box (measured ~1.5 GFLOP/s: a b32@224
