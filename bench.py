@@ -372,6 +372,15 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         kfac_img_per_s_chip=round(batch / t_amort, 1),
         overhead_pct=round(overhead_pct, 2),
         overhead_alt_schedule_f200_e2000_pct=round(overhead_alt_pct, 2),
+        # per-phase device cost by step-variant deltas (the step is ONE
+        # compiled program, so phases can't be timed in isolation; the SGD
+        # arm isolates the every-step precondition tax —
+        # docs/OBSERVABILITY.md "Per-phase timing")
+        phase_breakdown_ms={
+            "precondition": round((t_plain - t_sgd) * 1e3, 3),
+            "factor": round((t_fac - t_plain) * 1e3, 3),
+            "eigh": round((t_full - t_fac) * 1e3, 3),
+        },
     )
     return rec
 
@@ -462,6 +471,11 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
         "kfac_eigen_ms": round(t_full * 1e3, 3),
         "kfac_amortized_ms": round(t_amort * 1e3, 3),
         "overhead_pct": round(overhead_pct, 2),
+        "phase_breakdown_ms": {
+            "precondition": round((t_plain - t_sgd) * 1e3, 3),
+            "factor": round((t_fac - t_plain) * 1e3, 3),
+            "eigh": round((t_full - t_fac) * 1e3, 3),
+        },
     })
     return out
 

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, runtime
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, observability, runtime
+from kfac_pytorch_tpu.compile_cache import RecompileMonitor
 from kfac_pytorch_tpu.models import cifar_resnet
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
@@ -44,6 +45,18 @@ from kfac_pytorch_tpu.training import data as data_lib
 from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import kfac_flags_for_step, make_sgd
+
+# per-step K-FAC health keys (beyond the original nu / min-eig pair) that
+# --kfac-diagnostics reduces to per-epoch means; names match
+# observability.diagnostics.diagnostic_metrics output
+DIAG_EXTRA_KEYS = (
+    "kfac_max_damped_eig",
+    "kfac_cond_max",
+    "kfac_grad_norm",
+    "kfac_update_norm",
+    "kfac_update_grad_cos",
+    "kfac_eigen_stale_steps",
+)
 
 
 def parse_args(argv=None):
@@ -143,6 +156,11 @@ def parse_args(argv=None):
                         "math stay f32)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="enable structured telemetry and write metrics.prom "
+                        "(Prometheus textfile) + telemetry.jsonl there each "
+                        "epoch: per-phase span timings, recompile counter, "
+                        "K-FAC health gauges (docs/OBSERVABILITY.md)")
     p.add_argument("--kfac-diagnostics", action="store_true",
                    help="log per-epoch K-FAC stability telemetry (KL-clip "
                         "coefficient nu min/mean, min damped eigenvalue) to "
@@ -160,6 +178,9 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     rng = np.random.RandomState(args.seed)
+
+    # enable BEFORE any spans fire (launch.initialize below has comm spans)
+    tel = observability.configure(enabled=bool(args.telemetry_dir))
 
     launch.initialize()  # multi-host wiring; no-op single-process
     mesh = data_parallel_mesh()
@@ -305,11 +326,22 @@ def main(argv=None):
     # checked only AFTER the host-agreed fallback above: cifar_dir is now
     # identical on every host, so this SystemExit fires uniformly instead of
     # desyncing a pod where only some hosts have the data on disk
-    if cifar_dir and args.synth_classes != 10:
+    synth_overrides = [
+        flag
+        for flag, value, default in (
+            ("--synth-classes", args.synth_classes, 10),
+            ("--synth-prototypes", args.synth_prototypes, 10),
+            ("--synth-noise", args.synth_noise, 0.55),
+            ("--synth-label-noise", args.synth_label_noise, 0.08),
+            ("--synth-val-label-noise", args.synth_val_label_noise, 0.0),
+        )
+        if value != default
+    ]
+    if cifar_dir and synth_overrides:
         raise SystemExit(
-            "--synth-classes only applies to the learnable stand-in, but "
-            "real CIFAR-10 (10 classes) was found on disk; drop the flag or "
-            "the data"
+            f"{'/'.join(synth_overrides)} only apply to the learnable "
+            "stand-in, but real CIFAR-10 (10 classes) was found on disk — "
+            "the flags would be silently ignored; drop them or the data"
         )
     train_loader = None
     x_train = x_val = None
@@ -348,6 +380,21 @@ def main(argv=None):
         steps_per_epoch = min(steps_per_epoch, args.steps_per_epoch)
 
     writer = ScalarWriter(args.log_dir, enabled=jax.process_index() == 0)
+    tel_writer = ScalarWriter(
+        args.telemetry_dir,
+        enabled=tel.enabled and launch.is_primary(),
+        filename="telemetry.jsonl",
+    )
+    recompiles = RecompileMonitor(tel)
+    # legitimate variant counts: plain/factors/factors+eigen (×2 for the
+    # warmup-diag flag while a diag_warmup schedule is active)
+    recompiles.watch(
+        "train_step", train_step,
+        (3 if kfac.diag_warmup == 0 else 6) if kfac else 1,
+    )
+    recompiles.watch("eval_step", eval_step, 1)
+    if bn_recal is not None:
+        recompiles.watch("bn_recal", bn_recal, 1)
     step = int(jax.device_get(state.step))
 
     for epoch in range(resume_from_epoch, args.epochs):
@@ -369,6 +416,7 @@ def main(argv=None):
         t0 = time.perf_counter()
         loss_m, acc_m = Metric("train/loss"), Metric("train/accuracy")
         nu_min, nu_sum, nu_n, eig_min = 1.0, 0.0, 0, None
+        diag_acc = {}  # extra diagnostic keys -> (sum, count)
 
         def eat(m):
             nonlocal nu_min, nu_sum, nu_n, eig_min
@@ -379,10 +427,17 @@ def main(argv=None):
                 nu_min, nu_sum, nu_n = min(nu_min, nu), nu_sum + nu, nu_n + 1
                 e = float(m["kfac_min_damped_eig"])
                 eig_min = e if eig_min is None else min(eig_min, e)
+            for k in DIAG_EXTRA_KEYS:
+                if k in m:
+                    s, c = diag_acc.get(k, (0.0, 0))
+                    diag_acc[k] = (s + float(m[k]), c + 1)
 
         # metrics fetched a few steps late: the loop stays async (no
         # per-step host sync) while the lag window bounds in-flight
-        # batches/steps so queued input buffers can't accumulate in HBM
+        # batches/steps so queued input buffers can't accumulate in HBM.
+        # With --telemetry-dir the step-variant spans block() on the step's
+        # metrics instead — a deliberate per-step sync that buys honest
+        # device-inclusive per-variant timings.
         pending = []
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
             for i, (xb, yb) in enumerate(batches):
@@ -391,14 +446,26 @@ def main(argv=None):
                 lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
                 damping = kfac.hparams.damping if kfac else 0.0
                 flags = kfac_flags_for_step(step, kfac, epoch)
-                batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
-                state, metrics = train_step(
-                    state, batch, jnp.float32(lr), jnp.float32(damping), **flags
-                )
+                with tel.span("comm/host_to_device"):
+                    batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
+                if not flags.get("update_factors"):
+                    sp = tel.span("step/plain")
+                elif flags.get("update_eigen"):
+                    sp = tel.span("step/eigen")
+                else:
+                    sp = tel.span("step/factors")
+                with sp:
+                    state, metrics = train_step(
+                        state, batch, jnp.float32(lr), jnp.float32(damping),
+                        **flags
+                    )
+                    sp.block(metrics)
                 step += 1
                 pending.append(metrics)
                 if len(pending) > 2:
-                    eat(jax.device_get(pending.pop(0)))
+                    with tel.span("comm/device_get"):
+                        m = jax.device_get(pending.pop(0))
+                    eat(m)
             for m in jax.device_get(pending):
                 eat(m)
         dt = time.perf_counter() - t0
@@ -415,9 +482,21 @@ def main(argv=None):
             writer.add_scalar("kfac/nu_min", nu_min, epoch)
             writer.add_scalar("kfac/nu_mean", nu_sum / nu_n, epoch)
             writer.add_scalar("kfac/min_damped_eig", eig_min, epoch)
+            means = {k: s / c for k, (s, c) in sorted(diag_acc.items())}
+            for k, v in means.items():
+                # kfac_cond_max -> kfac/cond_max_mean
+                writer.add_scalar(f"kfac/{k[5:]}_mean", v, epoch)
             if launch.is_primary():
                 print(f"  kfac: nu_min={nu_min:.4f} nu_mean={nu_sum/nu_n:.4f} "
                       f"min_damped_eig={eig_min:.3e}")
+                if means:
+                    print(
+                        "  kfac: "
+                        f"cond_max={means.get('kfac_cond_max', 0.0):.3e} "
+                        f"upd_cos={means.get('kfac_update_grad_cos', 0.0):.3f} "
+                        "stale="
+                        f"{means.get('kfac_eigen_stale_steps', 0.0):.1f}"
+                    )
 
         if x_val is not None:
             if bn_recal is not None and x_train is not None:
@@ -449,9 +528,44 @@ def main(argv=None):
             writer.add_scalar("val/loss", val_loss, epoch)
             writer.add_scalar("val/accuracy", val_acc, epoch)
 
+        if tel.enabled:
+            # per-phase device cost from step-variant p50 deltas (the step
+            # is ONE compiled program; docs/OBSERVABILITY.md explains why
+            # in-graph phases can't be timed directly)
+            p_plain = tel.percentiles("step/plain")
+            p_fac = tel.percentiles("step/factors")
+            p_eig = tel.percentiles("step/eigen")
+            p_h2d = tel.percentiles("comm/host_to_device")
+            if p_plain and p_fac:
+                tel.set_gauge(
+                    "phase/factor_ms", max(0.0, (p_fac[0] - p_plain[0]) * 1e3)
+                )
+            if p_fac and p_eig:
+                tel.set_gauge(
+                    "phase/eigh_ms", max(0.0, (p_eig[0] - p_fac[0]) * 1e3)
+                )
+            if p_h2d:
+                tel.set_gauge("phase/comm_ms", p_h2d[0] * 1e3)
+            excess = recompiles.check()
+            if excess and launch.is_primary():
+                print(f"  WARNING: unexpected recompiles (jit cache over "
+                      f"budget): {excess}")
+            if launch.is_primary():
+                observability.write_prometheus(
+                    os.path.join(args.telemetry_dir, "metrics.prom"), tel
+                )
+            observability.flush_jsonl(tel_writer, tel, epoch)
+
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
 
+    if tel.enabled:
+        # collective on multi-host: every rank calls, rank 0 prints
+        table = observability.summary_table(tel)
+        if launch.is_primary():
+            print("telemetry summary:")
+            print(table)
+    tel_writer.close()
     writer.close()
     return state
 

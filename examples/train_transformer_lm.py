@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
+from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture, observability
+from kfac_pytorch_tpu.compile_cache import RecompileMonitor
 from kfac_pytorch_tpu.models import transformer_lm
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.context import make_context_parallel_attention
@@ -94,12 +95,23 @@ def parse_args(argv=None):
                         "(--seq-parallel 1)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="enable structured telemetry and write metrics.prom "
+                        "(Prometheus textfile) + telemetry.jsonl there each "
+                        "epoch (docs/OBSERVABILITY.md)")
+    p.add_argument("--kfac-diagnostics", action="store_true",
+                   help="log per-epoch K-FAC stability telemetry (KL-clip "
+                        "nu, damped eigenvalue range, condition numbers, "
+                        "update/grad geometry) to --log-dir")
     p.add_argument("--seed", type=int, default=42)
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+
+    # enable BEFORE any spans fire (launch.initialize below has comm spans)
+    tel = observability.configure(enabled=bool(args.telemetry_dir))
 
     launch.initialize()
     devices = np.asarray(jax.devices())
@@ -167,6 +179,7 @@ def main(argv=None):
             fac_update_freq=args.kfac_cov_update_freq,
             kfac_update_freq=args.kfac_update_freq,
             mesh=mesh if devices.size > 1 else None,
+            track_diagnostics=args.kfac_diagnostics,
         )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
@@ -216,23 +229,42 @@ def main(argv=None):
         # shared train/val feed: BPTT segmentation (data_lib.bptt_batches)
         # device-put straight to the P(data, seq) layout
         for toks, tgts in data_lib.bptt_batches(stream, args.seq_len):
-            yield put_sharded_batch(
-                mesh,
-                (np.ascontiguousarray(toks), np.ascontiguousarray(tgts)),
-                batch_spec,
-            )
+            with tel.span("comm/host_to_device"):
+                batch = put_sharded_batch(
+                    mesh,
+                    (np.ascontiguousarray(toks), np.ascontiguousarray(tgts)),
+                    batch_spec,
+                )
+            yield batch
 
     stream = local_rows("train")
     max_steps = (stream.shape[1] - 1) // args.seq_len
     steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
 
     writer = ScalarWriter(args.log_dir, enabled=jax.process_index() == 0)
+    tel_writer = ScalarWriter(
+        args.telemetry_dir,
+        enabled=tel.enabled and launch.is_primary(),
+        filename="telemetry.jsonl",
+    )
+    recompiles = RecompileMonitor(tel)
+    recompiles.watch("train_step", step_fn, 3 if kfac else 1)
+    recompiles.watch("eval_step", eval_fn, 1)
     step = int(jax.device_get(state.step))
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
         t0 = time.perf_counter()
         loss_m = Metric("train/loss")
+        diag_acc = {}  # kfac_* diagnostic key -> (sum, count)
+
+        def eat(m):
+            loss_m.update(m["loss"])
+            for k, v in m.items():
+                if k.startswith("kfac_"):
+                    s, c = diag_acc.get(k, (0.0, 0))
+                    diag_acc[k] = (s + float(v), c + 1)
+
         # lag-window metric fetch: async dispatch, bounded in-flight batches
         pending = []
         with profiling.maybe_trace(args.log_dir, args.profile_epoch == epoch):
@@ -240,16 +272,27 @@ def main(argv=None):
                 if i >= steps_per_epoch:
                     break
                 flags = kfac_flags_for_step(step, kfac, epoch)
-                state, metrics = step_fn(
-                    state, batch, jnp.float32(args.base_lr),
-                    jnp.float32(kfac.hparams.damping if kfac else 0.0), **flags
-                )
+                if not flags.get("update_factors"):
+                    sp_t = tel.span("step/plain")
+                elif flags.get("update_eigen"):
+                    sp_t = tel.span("step/eigen")
+                else:
+                    sp_t = tel.span("step/factors")
+                with sp_t:
+                    state, metrics = step_fn(
+                        state, batch, jnp.float32(args.base_lr),
+                        jnp.float32(kfac.hparams.damping if kfac else 0.0),
+                        **flags
+                    )
+                    sp_t.block(metrics)
                 step += 1
                 pending.append(metrics)
                 if len(pending) > 2:
-                    loss_m.update(jax.device_get(pending.pop(0))["loss"])
+                    with tel.span("comm/device_get"):
+                        m = jax.device_get(pending.pop(0))
+                    eat(m)
             for m in jax.device_get(pending):
-                loss_m.update(m["loss"])
+                eat(m)
         dt = time.perf_counter() - t0
         ppl = float(np.exp(min(loss_m.avg, 20.0)))
         if launch.is_primary():
@@ -257,6 +300,17 @@ def main(argv=None):
             print(f"epoch {epoch}: loss={loss_m.avg:.4f} ppl={ppl:.1f} {tok_s:.0f} tok/s ({dt:.1f}s)")
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/ppl", ppl, epoch)
+        if diag_acc:
+            means = {k: s / c for k, (s, c) in sorted(diag_acc.items())}
+            for k, v in means.items():
+                writer.add_scalar(f"kfac/{k[5:]}_mean", v, epoch)
+            if launch.is_primary():
+                print(
+                    "  kfac: "
+                    f"nu={means.get('kfac_nu', 0.0):.4f} "
+                    f"cond_max={means.get('kfac_cond_max', 0.0):.3e} "
+                    f"upd_cos={means.get('kfac_update_grad_cos', 0.0):.3f}"
+                )
 
         if "valid" in splits:
             vl = Metric("val/loss")
@@ -268,8 +322,40 @@ def main(argv=None):
             writer.add_scalar("val/loss", vl.avg, epoch)
             writer.add_scalar("val/ppl", vppl, epoch)
 
+        if tel.enabled:
+            p_plain = tel.percentiles("step/plain")
+            p_fac = tel.percentiles("step/factors")
+            p_eig = tel.percentiles("step/eigen")
+            p_h2d = tel.percentiles("comm/host_to_device")
+            if p_plain and p_fac:
+                tel.set_gauge(
+                    "phase/factor_ms", max(0.0, (p_fac[0] - p_plain[0]) * 1e3)
+                )
+            if p_fac and p_eig:
+                tel.set_gauge(
+                    "phase/eigh_ms", max(0.0, (p_eig[0] - p_fac[0]) * 1e3)
+                )
+            if p_h2d:
+                tel.set_gauge("phase/comm_ms", p_h2d[0] * 1e3)
+            excess = recompiles.check()
+            if excess and launch.is_primary():
+                print(f"  WARNING: unexpected recompiles (jit cache over "
+                      f"budget): {excess}")
+            if launch.is_primary():
+                observability.write_prometheus(
+                    os.path.join(args.telemetry_dir, "metrics.prom"), tel
+                )
+            observability.flush_jsonl(tel_writer, tel, epoch)
+
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
+
+    if tel.enabled:
+        table = observability.summary_table(tel)  # collective: every rank
+        if launch.is_primary():
+            print("telemetry summary:")
+            print(table)
+    tel_writer.close()
     writer.close()
     return state
 

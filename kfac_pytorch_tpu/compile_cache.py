@@ -1,4 +1,4 @@
-"""Persistent XLA compilation cache setup.
+"""Persistent XLA compilation cache setup + silent-recompile detection.
 
 TPU eigh (QDWH) compiles slowly per distinct shape (minutes at n≥2048 —
 see ops/eigh.py). Shape bucketing bounds the number of compiles; this module
@@ -8,11 +8,20 @@ eigensolvers are shipped pre-compiled (kfac_preconditioner.py:252).
 
 Call :func:`enable_persistent_cache` BEFORE the first jit execution (import
 time is fine; the config flags only take effect at backend init).
+
+:class:`RecompileMonitor` is the runtime complement: the K-FAC trainer
+compiles a *known, bounded* set of step variants (plain / factors / eigen /
+warmup combinations picked by host-side static flags), so any growth of a
+jitted function's trace cache beyond that expectation is a silent recompile
+— usually a weak-ref'd hparam object or a shape drifting — and each one can
+cost 30s+. The monitor turns that into a telemetry counter
+(``compile/retraces``) instead of an invisible stall.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Dict
 
 _DEFAULT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
 
@@ -36,3 +45,57 @@ def enable_persistent_cache(path: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     return path
+
+
+class RecompileMonitor:
+    """Watch jitted functions for trace-cache growth beyond expectations.
+
+    Register each jitted callable with the number of compiled variants the
+    training schedule legitimately produces (e.g. a K-FAC step has up to 4:
+    plain / factors-only / factors+eigen / warmup-diag). ``check()`` reads
+    the function's trace-cache size (``_cache_size``, stable across the jax
+    versions this repo pins); any count above the expectation increments
+    the ``compile/retraces`` telemetry counter and is reported so the train
+    loop can warn. Cheap enough to call once per epoch.
+    """
+
+    def __init__(self, telemetry=None):
+        if telemetry is None:
+            from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+            telemetry = get_telemetry()
+        self._telemetry = telemetry
+        self._watched: Dict[str, tuple] = {}
+        self._reported: Dict[str, int] = {}
+
+    def watch(self, name: str, fn, expected_variants: int = 1) -> None:
+        """Track ``fn`` (a ``jax.jit`` result); ``expected_variants`` is the
+        number of distinct compiled programs the schedule should create."""
+        if not hasattr(fn, "_cache_size"):
+            return  # not a jitted function (e.g. an eager fallback) — skip
+        self._watched[name] = (fn, int(expected_variants))
+        self._reported.setdefault(name, 0)
+
+    def check(self) -> Dict[str, int]:
+        """Return {name: excess_compile_count} for watched fns over budget.
+
+        Each *new* excess compile since the last check bumps the
+        ``compile/retraces`` counter once, and the per-function totals are
+        mirrored into ``compile/cache_size/<name>``-style gauges so the
+        Prometheus view shows absolute cache sizes too.
+        """
+        excess: Dict[str, int] = {}
+        for name, (fn, budget) in self._watched.items():
+            try:
+                size = int(fn._cache_size())
+            except Exception:
+                continue
+            self._telemetry.set_gauge(f"compile/cache_size/{name}", size)
+            over = max(0, size - budget)
+            new = over - self._reported[name]
+            if new > 0:
+                self._telemetry.inc("compile/retraces", new)
+                self._reported[name] = over
+            if over:
+                excess[name] = over
+        return excess

@@ -1,0 +1,29 @@
+"""Structured telemetry for the K-FAC training stack.
+
+* :mod:`.telemetry` — spans, counters, gauges, histograms in a
+  process-wide registry (no-op when disabled).
+* :mod:`.export` — Prometheus textfile, JSONL stream, rank-aware summary.
+* :mod:`.diagnostics` — the in-graph K-FAC health-key vocabulary.
+
+The recompile detector (``RecompileMonitor``) lives in
+:mod:`kfac_pytorch_tpu.compile_cache` next to the compilation-cache setup
+it watches.
+"""
+
+from kfac_pytorch_tpu.observability.diagnostics import (  # noqa: F401
+    LAYER_COND_KEYS,
+    SCALAR_KEYS,
+    diagnostic_metrics,
+)
+from kfac_pytorch_tpu.observability.export import (  # noqa: F401
+    flush_jsonl,
+    prometheus_lines,
+    summary_table,
+    write_prometheus,
+)
+from kfac_pytorch_tpu.observability.telemetry import (  # noqa: F401
+    Span,
+    Telemetry,
+    configure,
+    get_telemetry,
+)

@@ -1,0 +1,57 @@
+"""K-FAC health-diagnostics pytree: key registry + metric flattening.
+
+The diagnostics themselves are computed IN-GRAPH (preconditioner.py, gated
+by ``track_diagnostics`` so the no-diagnostics program is untouched) and
+flow out of the jitted step inside ``state['kfac_state']['diagnostics']``.
+This module owns the shared vocabulary: which keys exist, and how the
+per-layer entries reduce to the flat ``kfac_*`` scalars the train loops
+log. Keeping the reduction here (traceable jnp code, callable inside the
+step) means step.py and lm_step.py cannot drift apart on key names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+# Scalar entries of the diagnostics pytree (state['diagnostics'][<key>]).
+# 'eigen_stale_steps' is int32; the rest are f32. See docs/OBSERVABILITY.md
+# for what each one means and which update path refreshes it.
+SCALAR_KEYS = (
+    "nu",
+    "min_damped_eig",
+    "max_damped_eig",
+    "grad_norm",
+    "update_norm",
+    "update_grad_cos",
+    "eigen_stale_steps",
+)
+
+# Per-layer entries: state['diagnostics']['layer_cond'][<layer>][<key>] —
+# raw factor condition numbers from the damped eigenvalue spectra,
+# refreshed on eigen-update steps (eigen method only).
+LAYER_COND_KEYS = ("cond_A", "cond_G")
+
+
+def diagnostic_metrics(diag: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+    """Flatten a diagnostics pytree into the ``kfac_*`` metric scalars.
+
+    Traceable (pure jnp): the train steps call this inside jit so the
+    reductions ride in the compiled program. The per-layer condition
+    numbers reduce to their max (the layer closest to numerical trouble);
+    the full per-layer map stays available in the checkpointable state for
+    offline inspection.
+    """
+    out = {f"kfac_{k}": diag[k] for k in SCALAR_KEYS if k in diag}
+    layer_cond = diag.get("layer_cond")
+    if layer_cond:
+        conds = [
+            e[k].astype(jnp.float32)
+            for e in layer_cond.values()
+            for k in LAYER_COND_KEYS
+            if k in e
+        ]
+        if conds:
+            out["kfac_cond_max"] = jnp.max(jnp.stack(conds))
+    return out

@@ -1,0 +1,162 @@
+"""Telemetry exporters: Prometheus textfile, JSONL stream, summary table.
+
+Three sinks over one :class:`~kfac_pytorch_tpu.observability.telemetry.
+Telemetry` snapshot:
+
+* :func:`write_prometheus` — the node-exporter *textfile collector*
+  contract: a ``metrics.prom`` file written whole and atomically renamed
+  into place, so a scraper never reads a torn file. Counters export as
+  ``counter``, gauges as ``gauge``, span histograms as ``summary``
+  (quantile-labeled p50/p95 plus ``_sum``/``_count``).
+* :func:`flush_jsonl` — appends the same snapshot to the machine-readable
+  JSONL stream via :class:`~kfac_pytorch_tpu.training.metrics.
+  ScalarWriter` (the artifact convergence curves are already committed
+  from), one record per metric, tagged with its kind.
+* :func:`summary_table` — the end-of-run human view: p50/p95/total per
+  span plus counters, aggregated to rank 0 over a multi-host world via
+  ``process_allgather`` (SPMD loops emit the same span names everywhere,
+  so the packed stat arrays line up; a shape mismatch falls back to the
+  local table rather than deadlocking a rank).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+from kfac_pytorch_tpu.observability.telemetry import Telemetry
+
+_PROM_PREFIX = "kfac"
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (``step/plain`` ->
+    ``kfac_step_plain``). Lossy but deterministic; the docs registry keys
+    on the registry name, so collisions would be caught there."""
+    return f"{_PROM_PREFIX}_{_SANITIZE.sub('_', name)}"
+
+
+def prometheus_lines(snapshot: Dict[str, Dict]) -> list:
+    """Render a :meth:`Telemetry.snapshot` in Prometheus text format."""
+    lines = []
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v:g}")
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v:g}")
+    for name, s in sorted(snapshot.get("spans", {}).items()):
+        pn = prom_name(name) + "_seconds"
+        lines.append(f"# TYPE {pn} summary")
+        lines.append(f'{pn}{{quantile="0.5"}} {s["p50"]:g}')
+        lines.append(f'{pn}{{quantile="0.95"}} {s["p95"]:g}')
+        lines.append(f"{pn}_sum {s['sum']:g}")
+        lines.append(f"{pn}_count {s['count']:g}")
+    return lines
+
+
+def write_prometheus(path: str, telemetry: Telemetry) -> str:
+    """Atomically (re)write ``path`` (e.g. ``<dir>/metrics.prom``).
+
+    Write-to-temp + ``os.replace`` so a concurrent textfile-collector
+    scrape sees either the old file or the new one, never a partial write.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(prometheus_lines(telemetry.snapshot())) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def flush_jsonl(writer, telemetry: Telemetry, step: int) -> None:
+    """Append the current snapshot to a ScalarWriter's JSONL stream.
+
+    One record per metric: counters as ``counter/<name>``, gauges as
+    ``gauge/<name>``, spans as ``span/<name>/{p50_ms,p95_ms,count}``.
+    Span durations convert to milliseconds here (the JSONL stream is what
+    humans and plots read; Prometheus keeps base-unit seconds).
+    """
+    snap = telemetry.snapshot()
+    for name, v in sorted(snap["counters"].items()):
+        writer.add_scalar(f"counter/{name}", v, step)
+    for name, v in sorted(snap["gauges"].items()):
+        writer.add_scalar(f"gauge/{name}", v, step)
+    for name, s in sorted(snap["spans"].items()):
+        writer.add_scalar(f"span/{name}/p50_ms", s["p50"] * 1e3, step)
+        writer.add_scalar(f"span/{name}/p95_ms", s["p95"] * 1e3, step)
+        writer.add_scalar(f"span/{name}/count", s["count"], step)
+
+
+def _allgather_span_stats(names, spans):
+    """Stack every rank's (count,sum,p50,p95) rows for the agreed span-name
+    list; returns ``[n_proc, n_spans, 4]``."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(
+        [
+            [
+                spans.get(n, {}).get(k, 0.0)
+                for k in ("count", "sum", "p50", "p95")
+            ]
+            for n in names
+        ],
+        dtype=np.float64,
+    )
+    return multihost_utils.process_allgather(local)
+
+
+def summary_table(telemetry: Telemetry) -> str:
+    """Format the end-of-run summary (call on every rank; print on rank 0).
+
+    Single-process: the local snapshot. Multi-process: span stats
+    aggregate over ranks (count summed, p50/p95 averaged — each rank's
+    percentile of its own stream, then meaned; honest for SPMD loops where
+    streams are iid) via one ``process_allgather``. Every rank must call
+    this (it is a collective in the multi-process case).
+    """
+    snap = telemetry.snapshot()
+    names = sorted(snap["spans"])
+    rows = {
+        n: (s["count"], s["sum"], s["p50"], s["p95"])
+        for n, s in snap["spans"].items()
+    }
+    try:
+        import jax
+
+        n_proc = jax.process_count()
+    except Exception:
+        n_proc = 1
+    if n_proc > 1 and names:
+        try:
+            stats = _allgather_span_stats(names, snap["spans"])
+            rows = {
+                n: (
+                    float(stats[:, i, 0].sum()),
+                    float(stats[:, i, 1].sum()),
+                    float(stats[:, i, 2].mean()),
+                    float(stats[:, i, 3].mean()),
+                )
+                for i, n in enumerate(names)
+            }
+        except Exception as e:  # name sets diverged across ranks
+            rows["<local-only>"] = (0.0, 0.0, 0.0, 0.0)
+            print(f"WARNING: cross-rank telemetry aggregation failed ({e}); "
+                  "showing this rank's spans only")
+    lines = [
+        f"{'span':<40} {'count':>8} {'p50 ms':>10} {'p95 ms':>10} {'total s':>10}"
+    ]
+    for n in sorted(rows):
+        c, tot, p50, p95 = rows[n]
+        lines.append(
+            f"{n:<40} {int(c):>8} {p50 * 1e3:>10.3f} {p95 * 1e3:>10.3f} "
+            f"{tot:>10.2f}"
+        )
+    for n, v in sorted(snap["counters"].items()):
+        lines.append(f"{'counter ' + n:<40} {v:>8g}")
+    return "\n".join(lines)

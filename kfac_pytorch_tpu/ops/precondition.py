@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kfac_pytorch_tpu import compat
+
 _HIGHEST = lax.Precision.HIGHEST
 # Eigenbasis rotations default to HIGH (3-pass bf16 error compensation,
 # ~f32-accurate for orthonormal Q): the rotations are the EVERY-STEP hot path
@@ -290,7 +292,7 @@ def _apply_distributed(
     ]
 
     @partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=P(),

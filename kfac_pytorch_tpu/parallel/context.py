@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kfac_pytorch_tpu import compat
+
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 
 
@@ -160,7 +162,7 @@ def make_context_parallel_attention(
 
     def attn(q, k, v, causal: bool = True):
         f = partial(inner, axis_name=seq_axis, causal=causal)
-        return jax.shard_map(
+        return compat.shard_map(
             f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)

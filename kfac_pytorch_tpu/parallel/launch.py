@@ -150,7 +150,11 @@ def barrier(name: str = "barrier") -> None:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+    # span time here ≈ wait-for-slowest-host: the straggler gauge
+    with get_telemetry().span("comm/barrier"):
+        multihost_utils.sync_global_devices(name)
 
 
 def host_min(value: int) -> int:
@@ -164,7 +168,12 @@ def host_min(value: int) -> int:
         return int(value)
     from jax.experimental import multihost_utils
 
-    return int(np.min(multihost_utils.process_allgather(np.asarray(int(value)))))
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+    with get_telemetry().span("comm/host_min"):
+        return int(
+            np.min(multihost_utils.process_allgather(np.asarray(int(value))))
+        )
 
 
 def broadcast_host_value(value, root: int = 0):
@@ -175,6 +184,11 @@ def broadcast_host_value(value, root: int = 0):
         return value
     from jax.experimental import multihost_utils
 
-    arr = np.asarray(value)
-    out = multihost_utils.broadcast_one_to_all(arr, is_source=jax.process_index() == root)
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+    with get_telemetry().span("comm/broadcast"):
+        arr = np.asarray(value)
+        out = multihost_utils.broadcast_one_to_all(
+            arr, is_source=jax.process_index() == root
+        )
     return out.item() if np.ndim(value) == 0 else out

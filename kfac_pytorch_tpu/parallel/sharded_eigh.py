@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kfac_pytorch_tpu import compat
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.ops.eigh import (
     batched_eigh,
     bucket_size,
@@ -181,33 +183,39 @@ def sharded_eigen_update(
         )
 
     @partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         in_specs=P(),
         out_specs=P(),
         check_vma=False,
     )
     def _inner(facs):
+        # trace-time spans only (we are inside shard_map/jit): they cost
+        # nothing in the compiled program but let the telemetry view show
+        # how much of an eigen-step's TRACE time is eigh vs exchange logic
+        tel = get_telemetry()
         # flat device index over ALL mesh axes, row-major in axis_names order
         dev = lax.axis_index(axes[0])
         for a in axes[1:]:
             dev = dev * mesh.shape[a] + lax.axis_index(a)
         per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         for m, idxs in groups.items():
-            all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
-            idx_tab, valid = tables[m]
-            mine = jnp.take(idx_tab, dev, axis=0)  # [rows]
-            vmask = jnp.take(valid, dev, axis=0)  # [rows]
-            stack = jnp.take(all_blocks, mine, axis=0)  # [rows, m, m]
-            q, d = batched_eigh(stack)
-            q = q * vmask[:, None, None]
-            d = d * vmask[:, None]
+            with tel.span("trace/eigh/compute"):
+                all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
+                idx_tab, valid = tables[m]
+                mine = jnp.take(idx_tab, dev, axis=0)  # [rows]
+                vmask = jnp.take(valid, dev, axis=0)  # [rows]
+                stack = jnp.take(all_blocks, mine, axis=0)  # [rows, m, m]
+                q, d = batched_eigh(stack)
+                q = q * vmask[:, None, None]
+                d = d * vmask[:, None]
             k = len(idxs)
-            # Sum-of-zeros exchange: scatter-add my rows, psum the rest in.
-            kq = jnp.zeros((k, m, m), jnp.float32).at[mine].add(q)
-            kd = jnp.zeros((k, m), jnp.float32).at[mine].add(d)
-            kq = lax.psum(kq, axes)
-            kd = lax.psum(kd, axes)
+            with tel.span("trace/eigh/exchange"):
+                # Sum-of-zeros exchange: scatter-add my rows, psum the rest in.
+                kq = jnp.zeros((k, m, m), jnp.float32).at[mine].add(q)
+                kd = jnp.zeros((k, m), jnp.float32).at[mine].add(d)
+                kq = lax.psum(kq, axes)
+                kd = lax.psum(kd, axes)
             for row, i in enumerate(idxs):
                 per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
         return _assemble(facs, slots, per_slot)

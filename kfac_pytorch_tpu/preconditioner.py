@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
 from kfac_pytorch_tpu.parallel.assignment import (
@@ -345,10 +346,23 @@ class KFAC:
         if self.track_diagnostics:
             # fixed from init so the state pytree structure never changes
             # (a mid-run structure flip would retrace the jitted step and
-            # break checkpoint/donation contracts)
+            # break checkpoint/donation contracts). Key vocabulary:
+            # observability/diagnostics.py; semantics: docs/OBSERVABILITY.md.
             state["diagnostics"] = {
                 "nu": jnp.ones((), jnp.float32),
                 "min_damped_eig": jnp.zeros((), jnp.float32),
+                "max_damped_eig": jnp.zeros((), jnp.float32),
+                "grad_norm": jnp.zeros((), jnp.float32),
+                "update_norm": jnp.zeros((), jnp.float32),
+                "update_grad_cos": jnp.zeros((), jnp.float32),
+                "eigen_stale_steps": jnp.zeros((), jnp.int32),
+                "layer_cond": {
+                    name: {
+                        "cond_A": jnp.zeros((), jnp.float32),
+                        "cond_G": jnp.zeros((), jnp.float32),
+                    }
+                    for name in names
+                },
             }
         return state
 
@@ -401,6 +415,12 @@ class KFAC:
                 node = node[k]
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
 
+        # Spans here run at TRACE time (update() executes inside jit): they
+        # measure per-phase tracing cost and emit NO ops into the program —
+        # device-side phase costs come from the host-side step-variant spans
+        # plus bench.py's variant deltas (docs/OBSERVABILITY.md).
+        tel = get_telemetry()
+
         facs = state["factors"]
         if update_factors:
             if a_contribs is None or g_factor_stats is None:
@@ -417,23 +437,27 @@ class KFAC:
                 )
             # EMA runs elementwise, so the same update serves dense A
             # matrices and embedding A_diag vectors (identity init = ones).
-            facs = {
-                name: {
-                    ("A_diag" if "A_diag" in facs[name] else "A"):
-                        factor_ops.update_running_avg(
-                            a_contribs[name],
-                            facs[name].get("A", facs[name].get("A_diag")),
-                            self.factor_decay,
+            with tel.span("trace/kfac/factor_update"):
+                facs = {
+                    name: {
+                        ("A_diag" if "A_diag" in facs[name] else "A"):
+                            factor_ops.update_running_avg(
+                                a_contribs[name],
+                                facs[name].get("A", facs[name].get("A_diag")),
+                                self.factor_decay,
+                            ),
+                        "G": factor_ops.update_running_avg(
+                            g_factor_stats[name], facs[name]["G"], self.factor_decay
                         ),
-                    "G": factor_ops.update_running_avg(
-                        g_factor_stats[name], facs[name]["G"], self.factor_decay
-                    ),
+                    }
+                    for name in names
                 }
-                for name in names
-            }
 
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
+        # Per-layer eigenvalue spectra captured (pre-split) on eigen-update
+        # steps for the health diagnostics; None on every other path.
+        fresh_spectra = None
         if update_eigen and self.precond_method == "inverse":
             # Curvature refresh, inverse method: π-damped Cholesky inverses.
             # Computed replicated — a batched Cholesky solve is ~30x cheaper
@@ -441,103 +465,112 @@ class KFAC:
             # kfac_update_freq amortization sharding it is not worth an
             # exchange; the EVERY-STEP solve still shards via
             # distribute_precondition.
-            inv = precond_ops.factored_inverse_all(
-                facs, jnp.asarray(damping, jnp.float32), self.eps
-            )
-            if self.eigen_dtype != jnp.float32:
-                inv = {
-                    # only the MATRIX inverses downcast; the embedding
-                    # iA_diag vector stays f32 like the eigen path's dA
-                    # (a dtype flip after the first refresh would retrace
-                    # the jitted step and break donated-buffer reuse)
-                    n: {
-                        k: (v if k == "iA_diag" else v.astype(self.eigen_dtype))
-                        for k, v in e.items()
+            with tel.span("trace/kfac/eigh"):
+                inv = precond_ops.factored_inverse_all(
+                    facs, jnp.asarray(damping, jnp.float32), self.eps
+                )
+                if self.eigen_dtype != jnp.float32:
+                    inv = {
+                        # only the MATRIX inverses downcast; the embedding
+                        # iA_diag vector stays f32 like the eigen path's dA
+                        # (a dtype flip after the first refresh would retrace
+                        # the jitted step and break donated-buffer reuse)
+                        n: {
+                            k: (v if k == "iA_diag" else v.astype(self.eigen_dtype))
+                            for k, v in e.items()
+                        }
+                        for n, e in inv.items()
                     }
-                    for n, e in inv.items()
-                }
-            eigen, stacked = precond_ops.split_inv_state(inv)
+                eigen, stacked = precond_ops.split_inv_state(inv)
         elif update_eigen:
             # diag_warmup: use 1 block until `epoch >= diag_warmup`
             # (kfac_preconditioner.py:361-367), via the static flag.
             diag_blocks = self.diag_blocks if diag_warmup_done else 1
             world = self._world()
-            if world > 1:
-                table = layer_assignment(
-                    names,
-                    is_conv,
-                    world,
-                    self.distribute_layer_factors,
-                    diag_blocks,
-                )
-                eigen = sharded_eigen_update(
-                    facs, table, self.mesh, self.axis_name, self.eps
-                )
-            else:
-                blocks = {
-                    name: (diag_blocks if is_conv[name] else 1) for name in names
-                }
-                eigen = replicated_eigen_update(facs, blocks, self.eps)
-            # Diagonal-A (embedding) layers: the A "eigendecomposition" is
-            # the diagonal itself (eigenvectors = identity) — no eigh, just
-            # the reference's eigenvalue floor (kfac_preconditioner.py:253).
-            for n in names:
-                if "A_diag" in facs[n]:
-                    d = facs[n]["A_diag"]
-                    eigen[n]["dA"] = d * (d > self.eps)
-            if self.eigen_dtype != jnp.float32:
-                # eigh itself always runs f32; only the stored/streamed Q
-                # matrices downcast (eigenvalues stay f32 for the divide)
-                eigen = {
-                    n: {
-                        k: (v.astype(self.eigen_dtype) if k.startswith("Q") else v)
-                        for k, v in e.items()
+            with tel.span("trace/kfac/eigh"):
+                if world > 1:
+                    table = layer_assignment(
+                        names,
+                        is_conv,
+                        world,
+                        self.distribute_layer_factors,
+                        diag_blocks,
+                    )
+                    eigen = sharded_eigen_update(
+                        facs, table, self.mesh, self.axis_name, self.eps
+                    )
+                else:
+                    blocks = {
+                        name: (diag_blocks if is_conv[name] else 1) for name in names
                     }
-                    for n, e in eigen.items()
-                }
-            eigen, stacked = precond_ops.split_eigen_state(eigen)
+                    eigen = replicated_eigen_update(facs, blocks, self.eps)
+                # Diagonal-A (embedding) layers: the A "eigendecomposition" is
+                # the diagonal itself (eigenvectors = identity) — no eigh, just
+                # the reference's eigenvalue floor (kfac_preconditioner.py:253).
+                for n in names:
+                    if "A_diag" in facs[n]:
+                        d = facs[n]["A_diag"]
+                        eigen[n]["dA"] = d * (d > self.eps)
+                if self.track_diagnostics:
+                    # grab the f32 per-layer spectra while the eigen dict is
+                    # still in full per-layer form (stacks lose layer keys)
+                    fresh_spectra = {
+                        n: (eigen[n]["dA"], eigen[n]["dG"]) for n in names
+                    }
+                if self.eigen_dtype != jnp.float32:
+                    # eigh itself always runs f32; only the stored/streamed Q
+                    # matrices downcast (eigenvalues stay f32 for the divide)
+                    eigen = {
+                        n: {
+                            k: (v.astype(self.eigen_dtype) if k.startswith("Q") else v)
+                            for k, v in e.items()
+                        }
+                        for n, e in eigen.items()
+                    }
+                eigen, stacked = precond_ops.split_eigen_state(eigen)
 
         # Precondition every layer's gradient, every step
         # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
-        lgrads = capture.layer_grads(grads, names)
-        gmats = {
-            name: mat.astype(jnp.float32)
-            for name, mat in capture.grad_mats(lgrads).items()
-        }
-        precision_args = (
-            (self.precond_precision,) if self.precond_precision is not None else ()
-        )
-        inverse = self.precond_method == "inverse"
-        if self.distribute_precondition and self._world() > 1:
-            owners = precondition_assignment(
-                {name: tuple(g.shape) for name, g in gmats.items()},
-                self._world(),
-                diag_a={n for n, f in facs.items() if "A_diag" in f},
+        with tel.span("trace/kfac/precondition"):
+            lgrads = capture.layer_grads(grads, names)
+            gmats = {
+                name: mat.astype(jnp.float32)
+                for name, mat in capture.grad_mats(lgrads).items()
+            }
+            precision_args = (
+                (self.precond_precision,) if self.precond_precision is not None else ()
             )
-            dist_fn = (
-                precond_ops.precondition_all_inv_distributed
-                if inverse
-                else precond_ops.precondition_all_distributed
-            )
-            updates = dist_fn(
-                gmats, eigen, damping, *precision_args, stacked=stacked,
-                mesh=self.mesh, owners=owners,
-                comm_dtype=self.precond_comm_dtype,
-            )
-        elif inverse:
-            updates = precond_ops.precondition_all_inv(
-                gmats, eigen, *precision_args, stacked=stacked
-            )
-        else:
-            updates = precond_ops.precondition_all(
-                gmats, eigen, damping, *precision_args, stacked=stacked
-            )
+            inverse = self.precond_method == "inverse"
+            if self.distribute_precondition and self._world() > 1:
+                owners = precondition_assignment(
+                    {name: tuple(g.shape) for name, g in gmats.items()},
+                    self._world(),
+                    diag_a={n for n, f in facs.items() if "A_diag" in f},
+                )
+                dist_fn = (
+                    precond_ops.precondition_all_inv_distributed
+                    if inverse
+                    else precond_ops.precondition_all_distributed
+                )
+                updates = dist_fn(
+                    gmats, eigen, damping, *precision_args, stacked=stacked,
+                    mesh=self.mesh, owners=owners,
+                    comm_dtype=self.precond_comm_dtype,
+                )
+            elif inverse:
+                updates = precond_ops.precondition_all_inv(
+                    gmats, eigen, *precision_args, stacked=stacked
+                )
+            else:
+                updates = precond_ops.precondition_all(
+                    gmats, eigen, damping, *precision_args, stacked=stacked
+                )
 
-        # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
-        nu = precond_ops.kl_clip_coefficient(
-            updates, gmats, lr, self.hparams.kl_clip
-        )
-        new_grads = capture.write_back(grads, updates, nu)
+            # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
+            nu = precond_ops.kl_clip_coefficient(
+                updates, gmats, lr, self.hparams.kl_clip
+            )
+            new_grads = capture.write_back(grads, updates, nu)
 
         new_state = {
             "step": state["step"] + 1,
@@ -546,26 +579,85 @@ class KFAC:
             "eigen_stacked": stacked,
         }
         if self.track_diagnostics:
-            min_eig = state["diagnostics"]["min_damped_eig"]
-            if update_eigen and self.precond_method == "eigen":
-                # λmin(G ⊗ A + λI) = min(dG)·min(dA) + λ (Kronecker
-                # eigenvalues are products; the stored dA/dG are already
-                # floored ≥ 0 by the eps floor in the eigh path)
-                mins = []
-                for e in list(eigen.values()) + list((stacked or {}).values()):
-                    if "dA" in e and "dG" in e:
-                        # axis=-1 keeps the reduction per-layer for stacked
-                        # [k, n] groups (min over rows of each layer's own
-                        # product, not a cross-layer pairing)
-                        mins.append(
-                            jnp.min(
-                                jnp.min(e["dG"].astype(jnp.float32), axis=-1)
-                                * jnp.min(e["dA"].astype(jnp.float32), axis=-1)
-                            )
-                        )
-                if mins:
-                    min_eig = jnp.min(jnp.stack(mins)) + jnp.asarray(
-                        damping, jnp.float32
-                    )
-            new_state["diagnostics"] = {"nu": nu, "min_damped_eig": min_eig}
+            new_state["diagnostics"] = self._diagnostics(
+                state["diagnostics"], fresh_spectra, gmats, updates, nu,
+                damping, update_eigen,
+            )
         return new_grads, new_state
+
+    def _diagnostics(
+        self,
+        prev: Dict[str, Any],
+        fresh_spectra: Optional[Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]],
+        gmats: Dict[str, jnp.ndarray],
+        updates: Dict[str, jnp.ndarray],
+        nu: jnp.ndarray,
+        damping,
+        update_eigen: bool,
+    ) -> Dict[str, Any]:
+        """Build the next diagnostics pytree (same structure as init()'s).
+
+        Spectrum-derived entries (min/max damped eig, per-layer factor
+        condition numbers) refresh only when ``fresh_spectra`` is present —
+        an eigen-method eigen-update step — and carry forward otherwise
+        (the inverse method never materializes eigenvalues). The norm/
+        cosine/staleness entries are cheap reductions computed every step.
+        """
+        lam = jnp.asarray(damping, jnp.float32)
+        min_eig = prev["min_damped_eig"]
+        max_eig = prev["max_damped_eig"]
+        layer_cond = prev["layer_cond"]
+        if fresh_spectra is not None:
+            mins, maxs, layer_cond = [], [], {}
+            for n, (da, dg) in fresh_spectra.items():
+                da = da.astype(jnp.float32)
+                dg = dg.astype(jnp.float32)
+                da_mn, da_mx = jnp.min(da), jnp.max(da)
+                dg_mn, dg_mx = jnp.min(dg), jnp.max(dg)
+                # λ of G ⊗ A are products of factor eigenvalues (dA/dG are
+                # already floored ≥ 0 by the eigh path's eps floor)
+                mins.append(dg_mn * da_mn)
+                maxs.append(dg_mx * da_mx)
+                # damped condition number: λ added to both ends bounds the
+                # ratio exactly as the damped solve does — a raw min of 0
+                # (floored eigenvalue) reads as (max+λ)/λ, the true
+                # amplification spread of the damped inverse, not inf
+                layer_cond[n] = {
+                    "cond_A": (da_mx + lam) / (da_mn + lam),
+                    "cond_G": (dg_mx + lam) / (dg_mn + lam),
+                }
+            min_eig = jnp.min(jnp.stack(mins)) + lam
+            max_eig = jnp.max(jnp.stack(maxs)) + lam
+
+        # Update-vs-gradient geometry, every step: the preconditioned
+        # direction's norm (as applied: ν-scaled) and its cosine to the raw
+        # gradient. cos → 0 or negative flags a curvature estimate at war
+        # with the loss signal; ‖update‖ spiking with ν ≈ 1 flags a trust
+        # region that is not engaging.
+        sq_g = sq_v = dot = jnp.asarray(0.0, jnp.float32)
+        for name, v in updates.items():
+            g = gmats[name].astype(jnp.float32)
+            v = v.astype(jnp.float32)
+            sq_g = sq_g + jnp.sum(g * g)
+            sq_v = sq_v + jnp.sum(v * v)
+            dot = dot + jnp.sum(v * g)
+        grad_norm = jnp.sqrt(sq_g)
+        upd_norm = jnp.sqrt(sq_v)
+        cos = dot / jnp.maximum(grad_norm * upd_norm, 1e-30)
+
+        return {
+            "nu": nu,
+            "min_damped_eig": min_eig,
+            "max_damped_eig": max_eig,
+            "grad_norm": grad_norm,
+            "update_norm": nu * upd_norm,
+            "update_grad_cos": cos,
+            # steps since the eigenbasis (or inverse) was last recomputed —
+            # static flag, so this is a plain int32 counter in-graph
+            "eigen_stale_steps": (
+                jnp.zeros((), jnp.int32)
+                if update_eigen
+                else prev["eigen_stale_steps"] + 1
+            ),
+            "layer_cond": layer_cond,
+        }

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.preconditioner import KFAC, KFACHParams
 
 
@@ -85,3 +86,10 @@ class KFACParamScheduler:
         factor = self.update_freq_factor_func(self.epoch)
         params.fac_update_freq = max(1, int(self.fac_update_freq_base * factor))
         params.kfac_update_freq = max(1, int(self.kfac_update_freq_base * factor))
+
+        # Mirror the live hyperparameters into telemetry gauges so an
+        # exported snapshot always shows which schedule point produced it.
+        tel = get_telemetry()
+        tel.set_gauge("kfac/damping", params.damping)
+        tel.set_gauge("kfac/fac_update_freq", params.fac_update_freq)
+        tel.set_gauge("kfac/kfac_update_freq", params.kfac_update_freq)

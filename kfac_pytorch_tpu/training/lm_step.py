@@ -17,8 +17,9 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu import capture, compat
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
+from kfac_pytorch_tpu.observability.diagnostics import diagnostic_metrics
 from kfac_pytorch_tpu.preconditioner import KFAC
 from kfac_pytorch_tpu.training.step import (
     TrainState,
@@ -117,7 +118,7 @@ def make_lm_train_step(
         axis = require_pure_dp_mesh(mesh)
 
         @partial(
-            jax.shard_map,
+            compat.shard_map,
             mesh=mesh,
             in_specs=(P(), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P(), P(), P(), P(axis)),
@@ -186,10 +187,7 @@ def make_lm_train_step(
 
         metrics = {"loss": loss, "ppl": jnp.exp(loss)}
         if kfac is not None and kfac.track_diagnostics:
-            metrics["kfac_nu"] = kfac_state["diagnostics"]["nu"]
-            metrics["kfac_min_damped_eig"] = kfac_state["diagnostics"][
-                "min_damped_eig"
-            ]
+            metrics.update(diagnostic_metrics(kfac_state["diagnostics"]))
         new_state = TrainState(
             step=state.step + 1,
             params=params,

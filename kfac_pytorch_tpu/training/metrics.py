@@ -40,15 +40,24 @@ class ScalarWriter:
     108-113). The JSONL stream (``scalars.jsonl``) is ALWAYS written — it is
     the machine-readable artifact convergence curves are committed from;
     TensorBoard is the interactive view on top when the package exists.
+    ``filename`` lets a second stream coexist in (or share the schema of)
+    the same run directory — the telemetry exporter
+    (observability/export.py::flush_jsonl) writes ``telemetry.jsonl``
+    through this class so both streams parse identically.
     """
 
-    def __init__(self, log_dir: Optional[str], enabled: bool = True):
+    def __init__(
+        self,
+        log_dir: Optional[str],
+        enabled: bool = True,
+        filename: str = "scalars.jsonl",
+    ):
         self._tb = None
         self._fh = None
         if not (enabled and log_dir):
             return
         os.makedirs(log_dir, exist_ok=True)
-        self._fh = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+        self._fh = open(os.path.join(log_dir, filename), "a")
         try:
             from torch.utils.tensorboard import SummaryWriter  # type: ignore
 

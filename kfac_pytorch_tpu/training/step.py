@@ -24,8 +24,9 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu import capture, compat
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
+from kfac_pytorch_tpu.observability.diagnostics import diagnostic_metrics
 from kfac_pytorch_tpu.preconditioner import KFAC
 
 PyTree = Any
@@ -82,7 +83,7 @@ def _compressed_grads(compute, mesh, comm_dtype, accum_steps):
     bspec = P(None, axis) if accum_steps > 1 else P(axis)
 
     @partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         in_specs=(P(), P(), bspec, bspec),
         out_specs=P(),
@@ -447,10 +448,7 @@ def make_train_step(
 
         metrics = {"loss": loss, "accuracy": acc}
         if kfac is not None and kfac.track_diagnostics:
-            metrics["kfac_nu"] = kfac_state["diagnostics"]["nu"]
-            metrics["kfac_min_damped_eig"] = kfac_state["diagnostics"][
-                "min_damped_eig"
-            ]
+            metrics.update(diagnostic_metrics(kfac_state["diagnostics"]))
         new_state = TrainState(
             step=state.step + 1,
             params=params,

@@ -25,3 +25,11 @@ else:
     from kfac_pytorch_tpu.platform_override import force_cpu_devices
 
     assert force_cpu_devices(8), "JAX backend initialized before conftest ran"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-long on the 8-device CPU mesh; excluded from the "
+        "tier-1 pass (`-m 'not slow'`), run explicitly or on real hardware",
+    )

@@ -31,6 +31,7 @@ def imagenet_shards(tmp_path):
     return d
 
 
+@pytest.mark.slow  # ~5-7 min of 8-device XLA compile on CPU
 def test_imagenet_trainer_end_to_end(imagenet_shards, tmp_path):
     import train_imagenet_resnet as t
 
@@ -66,6 +67,7 @@ def test_imagenet_trainer_rejects_undersized_val_resize(imagenet_shards):
         ])
 
 
+@pytest.mark.slow  # ~5-7 min of 8-device XLA compile on CPU
 def test_evaluate_cli_matches_trainer_val(imagenet_shards, tmp_path):
     """examples/evaluate.py on the trainer's checkpoint reproduces the
     trainer's final val metrics (same weights, same shared eval path)."""

@@ -1,0 +1,371 @@
+"""Telemetry subsystem: spans, exporters, recompile monitor, diagnostics.
+
+Covers the observability PR's acceptance points: span timing/nesting, the
+disabled zero-allocation path, the Prometheus textfile round-trip, the
+recompile counter firing on a forced retrace, diagnostics keys appearing
+iff ``track_diagnostics``, and the metric-name registry lint staying
+clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu.compile_cache import RecompileMonitor
+from kfac_pytorch_tpu.observability import (
+    LAYER_COND_KEYS,
+    SCALAR_KEYS,
+    diagnostic_metrics,
+    flush_jsonl,
+    prometheus_lines,
+    summary_table,
+    write_prometheus,
+)
+from kfac_pytorch_tpu.observability.export import prom_name
+from kfac_pytorch_tpu.observability.telemetry import (
+    _NULL_SPAN,
+    Telemetry,
+    configure,
+    get_telemetry,
+)
+from kfac_pytorch_tpu.preconditioner import KFAC
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- core registry --------------------------------------------------------
+
+
+def test_span_records_duration():
+    tel = Telemetry(enabled=True)
+    with tel.span("step/plain"):
+        time.sleep(0.01)
+    (p50, p95) = tel.percentiles("step/plain")
+    assert 0.005 < p50 < 1.0
+    assert p95 >= p50
+    snap = tel.snapshot()
+    assert snap["spans"]["step/plain"]["count"] == 1.0
+
+
+def test_span_nesting_is_independent():
+    tel = Telemetry(enabled=True)
+    with tel.span("step/eigen"):
+        with tel.span("trace/kfac/eigh"):
+            time.sleep(0.005)
+        time.sleep(0.005)
+    outer = tel.percentiles("step/eigen")[0]
+    inner = tel.percentiles("trace/kfac/eigh")[0]
+    # each span records its own duration; the outer includes the inner
+    assert outer > inner > 0.0
+    assert set(tel.snapshot()["spans"]) == {"step/eigen", "trace/kfac/eigh"}
+
+
+def test_span_block_syncs_device_values():
+    tel = Telemetry(enabled=True)
+    x = jnp.ones((64, 64))
+    with tel.span("step/plain") as sp:
+        y = jnp.dot(x, x)
+        sp.block(y)
+    assert tel.percentiles("step/plain")[0] > 0.0
+
+
+def test_disabled_is_null_and_allocation_free():
+    tel = Telemetry(enabled=False)
+    # the no-op span is a shared singleton: no per-call allocation
+    assert tel.span("step/plain") is _NULL_SPAN
+    assert tel.span("step/eigen") is tel.span("step/plain")
+    with tel.span("step/plain") as sp:
+        sp.block(jnp.ones(3))  # must be a no-op, not a sync
+    tel.inc("compile/retraces")
+    tel.set_gauge("kfac/damping", 1.0)
+    tel.observe("step/plain", 0.5)
+    assert tel.counters == {} and tel.gauges == {} and tel.hists == {}
+    assert tel.snapshot() == {"counters": {}, "gauges": {}, "spans": {}}
+
+
+def test_global_registry_configure():
+    tel = get_telemetry()
+    prev = tel.enabled
+    try:
+        assert configure(enabled=True) is tel
+        assert tel.enabled
+        configure(enabled=False)
+        assert tel.span("step/plain") is _NULL_SPAN
+    finally:
+        tel.enabled = prev
+        tel.reset()
+
+
+def test_counters_and_gauges():
+    tel = Telemetry(enabled=True)
+    tel.inc("compile/retraces")
+    tel.inc("compile/retraces", 2)
+    tel.set_gauge("kfac/damping", 0.03)
+    tel.set_gauge("kfac/damping", 0.01)  # last-value-wins
+    snap = tel.snapshot()
+    assert snap["counters"]["compile/retraces"] == 3.0
+    assert snap["gauges"]["kfac/damping"] == 0.01
+
+
+# -- exporters ------------------------------------------------------------
+
+
+def _parse_prom(text):
+    """metric-name -> {labels-or-'' : value} for non-comment lines."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        lhs, val = line.rsplit(" ", 1)
+        out[lhs] = float(val)
+    return out
+
+
+def test_prometheus_roundtrip(tmp_path):
+    tel = Telemetry(enabled=True)
+    tel.inc("compile/retraces", 2)
+    tel.set_gauge("kfac/damping", 0.03)
+    for v in (0.010, 0.020, 0.030):
+        tel.observe("step/plain", v)
+    path = str(tmp_path / "metrics.prom")
+    assert write_prometheus(path, tel) == path
+    assert not os.path.exists(path + ".tmp")  # atomic rename, no litter
+    text = open(path).read()
+    vals = _parse_prom(text)
+    assert vals["kfac_compile_retraces"] == 2.0
+    assert vals["kfac_kfac_damping"] == 0.03
+    assert vals["kfac_step_plain_seconds_count"] == 3.0
+    np.testing.assert_allclose(vals["kfac_step_plain_seconds_sum"], 0.06)
+    assert 'kfac_step_plain_seconds{quantile="0.5"}' in vals
+    assert 'kfac_step_plain_seconds{quantile="0.95"}' in vals
+    # TYPE declarations present for every family
+    for t in ("counter", "gauge", "summary"):
+        assert f"# TYPE" in text and t in text
+
+
+def test_prom_name_sanitization():
+    assert prom_name("step/plain") == "kfac_step_plain"
+    assert prom_name("compile/cache_size/train-step") == (
+        "kfac_compile_cache_size_train_step"
+    )
+
+
+def test_flush_jsonl(tmp_path):
+    from kfac_pytorch_tpu.training.metrics import ScalarWriter
+
+    tel = Telemetry(enabled=True)
+    tel.inc("compile/retraces")
+    tel.set_gauge("phase/eigh_ms", 12.5)
+    tel.observe("step/plain", 0.5)
+    w = ScalarWriter(str(tmp_path), enabled=True, filename="telemetry.jsonl")
+    flush_jsonl(w, tel, step=7)
+    w.close()
+    recs = [
+        json.loads(line)
+        for line in open(tmp_path / "telemetry.jsonl")
+    ]
+    tags = {r["tag"]: r["value"] for r in recs}
+    assert tags["counter/compile/retraces"] == 1.0
+    assert tags["gauge/phase/eigh_ms"] == 12.5
+    assert tags["span/step/plain/p50_ms"] == 500.0
+    assert tags["span/step/plain/count"] == 1.0
+    assert all(r["step"] == 7 for r in recs)
+
+
+def test_summary_table_single_process():
+    tel = Telemetry(enabled=True)
+    tel.observe("step/plain", 0.002)
+    tel.inc("compile/retraces")
+    table = summary_table(tel)
+    assert "step/plain" in table
+    assert "counter compile/retraces" in table
+
+
+# -- recompile monitor ----------------------------------------------------
+
+
+def test_recompile_monitor_counts_retraces():
+    tel = Telemetry(enabled=True)
+    mon = RecompileMonitor(tel)
+
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    f(jnp.ones((2,)))
+    mon.watch("f", f, expected_variants=1)
+    assert mon.check() == {}  # within budget
+
+    f(jnp.ones((3,)))  # forced retrace: new shape
+    excess = mon.check()
+    assert excess == {"f": 1}
+    assert tel.counters["compile/retraces"] == 1.0
+    assert tel.gauges["compile/cache_size/f"] == 2.0
+
+    # a second check with no new compiles must not double-count
+    assert mon.check() == {"f": 1}
+    assert tel.counters["compile/retraces"] == 1.0
+
+    f(jnp.ones((4,)))
+    mon.check()
+    assert tel.counters["compile/retraces"] == 2.0
+
+
+def test_recompile_monitor_skips_non_jitted():
+    mon = RecompileMonitor(Telemetry(enabled=True))
+    mon.watch("plain", lambda x: x)
+    assert mon.check() == {}
+
+
+# -- K-FAC diagnostics ----------------------------------------------------
+
+
+def _fc_problem(seed=3):
+    from kfac_pytorch_tpu.ops import factors as F
+
+    rng = np.random.RandomState(seed)
+    params = {"fc": {"kernel": jnp.asarray(rng.randn(5, 4).astype(np.float32))}}
+    acts = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+    gout = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    a_c = {"fc": F.compute_a_dense(acts, has_bias=False)}
+    g_s = {"fc": F.compute_g_dense(gout, batch_averaged=True)}
+    grads = {"fc": {"kernel": jnp.asarray(rng.randn(5, 4).astype(np.float32))}}
+    return params, a_c, g_s, grads
+
+
+def test_diagnostic_metrics_keys_iff_tracked():
+    params, a_c, g_s, grads = _fc_problem()
+    kw = dict(a_contribs=a_c, g_factor_stats=g_s, lr=0.1, damping=0.01,
+              update_factors=True, update_eigen=True)
+
+    kfac = KFAC(damping=0.01, track_diagnostics=True)
+    _, state = kfac.update(grads, kfac.init(params), **kw)
+    metrics = diagnostic_metrics(state["diagnostics"])
+    want = {f"kfac_{k}" for k in SCALAR_KEYS} | {"kfac_cond_max"}
+    assert set(metrics) == want
+    assert len(want) >= 6  # ISSUE acceptance: >= 6 health keys
+    # all finite scalars
+    for k, v in metrics.items():
+        assert jnp.ndim(v) == 0, k
+        assert bool(jnp.isfinite(v)), k
+    # per-layer condition numbers live in the state, >= 1 by construction
+    lc = state["diagnostics"]["layer_cond"]["fc"]
+    assert set(lc) == set(LAYER_COND_KEYS)
+    assert float(lc["cond_A"]) >= 1.0 and float(lc["cond_G"]) >= 1.0
+    np.testing.assert_allclose(
+        float(metrics["kfac_cond_max"]),
+        max(float(lc["cond_A"]), float(lc["cond_G"])),
+        rtol=1e-6,
+    )
+
+    # untracked: no diagnostics in state at all (pytree stability)
+    kfac_off = KFAC(damping=0.01)
+    _, state_off = kfac_off.update(grads, kfac_off.init(params), **kw)
+    assert "diagnostics" not in state_off
+
+
+def test_diagnostics_update_grad_geometry():
+    params, a_c, g_s, grads = _fc_problem(seed=11)
+    kfac = KFAC(damping=0.01, track_diagnostics=True)
+    _, state = kfac.update(
+        grads, state := kfac.init(params), a_contribs=a_c, g_factor_stats=g_s,
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True,
+    )
+    d = state["diagnostics"]
+    g = np.asarray(grads["fc"]["kernel"], np.float32)
+    np.testing.assert_allclose(
+        float(d["grad_norm"]), np.linalg.norm(g), rtol=1e-5
+    )
+    assert -1.0 <= float(d["update_grad_cos"]) <= 1.0
+    # damped F is PD => preconditioned grad keeps positive alignment
+    assert float(d["update_grad_cos"]) > 0.0
+    assert float(d["update_norm"]) > 0.0
+    assert int(d["eigen_stale_steps"]) == 0
+
+
+def test_diagnostics_staleness_sawtooth():
+    params, a_c, g_s, grads = _fc_problem(seed=5)
+    kfac = KFAC(damping=0.01, track_diagnostics=True)
+    state = kfac.init(params)
+    _, state = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s, lr=0.1,
+        damping=0.01, update_factors=True, update_eigen=True,
+    )
+    for want in (1, 2, 3):
+        _, state = kfac.update(
+            grads, state, lr=0.1, damping=0.01,
+            update_factors=False, update_eigen=False,
+        )
+        assert int(state["diagnostics"]["eigen_stale_steps"]) == want
+    _, state = kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s, lr=0.1,
+        damping=0.01, update_factors=True, update_eigen=True,
+    )
+    assert int(state["diagnostics"]["eigen_stale_steps"]) == 0
+
+
+def test_diagnostics_in_jitted_step_metrics():
+    """End-to-end: a jitted train step surfaces kfac_* metrics iff tracked."""
+    import flax.linen as nn
+    import optax
+
+    from kfac_pytorch_tpu.models.layers import KFACDense
+    from kfac_pytorch_tpu.training.step import TrainState, make_train_step
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return KFACDense(3, name="fc")(x.reshape((x.shape[0], -1)))
+
+    model = Tiny()
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((4, 6))
+    y = jnp.zeros((4,), jnp.int32)
+    variables = model.init(rng, x)
+    tx = optax.trace(decay=0.9)
+
+    def build(track):
+        kfac = KFAC(damping=0.01, track_diagnostics=track)
+        # fresh leaves each time: the jitted step donates its state buffers
+        params = jax.tree_util.tree_map(jnp.array, variables["params"])
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=tx.init(params),
+            kfac_state=kfac.init(params),
+        )
+        step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+        return step(
+            state, (x, y), 0.1, 0.01,
+            update_factors=True, update_eigen=True,
+        )
+
+    _, metrics_on = build(True)
+    assert {k for k in metrics_on if k.startswith("kfac_")} >= {
+        "kfac_nu", "kfac_min_damped_eig", "kfac_cond_max",
+        "kfac_grad_norm", "kfac_update_norm", "kfac_update_grad_cos",
+    }
+    _, metrics_off = build(False)
+    assert not any(k.startswith("kfac_") for k in metrics_off)
+
+
+# -- registry lint --------------------------------------------------------
+
+
+def test_metric_names_lint_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_metric_names.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
