@@ -248,7 +248,7 @@ def _timeit(step, state, warmup=2, iters=20, windows=3, label=""):
             state = step(state)
         state = jax.block_until_ready(state)
         times.append((time.perf_counter() - t0) / iters)
-    return float(np.mean(times)), float(np.std(times)), state
+    return float(np.mean(times)), float(np.std(times)), times, state
 
 
 def _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq):
@@ -258,6 +258,32 @@ def _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq):
     f_full = 1.0 / kfac_freq
     f_fac = 1.0 / fac_freq - f_full
     return (1.0 - f_fac - f_full) * t_plain + f_fac * t_fac + f_full * t_full
+
+
+def _schedule_stats(win_plain, win_fac, win_boundary, fac_freq, kfac_freq):
+    """p50/p95/max per-step time (ms) over one ``kfac_update_freq`` interval.
+
+    Expands the schedule step-by-step and lets each step contribute ALL of
+    its variant's timing-window samples, so the percentiles reflect both the
+    schedule mix and the window-to-window spread. ``win_boundary`` is a list
+    of window-sample lists for the steps at the interval head: ``[win_full]``
+    for the monolithic refresh (the spike IS the max), or the K per-chunk
+    window lists for the pipelined refresh (the spike is spread). A mean±std
+    hides exactly this — the refresh spike only shows at p95/max."""
+    samples = []
+    for s in range(kfac_freq):
+        if s < len(win_boundary):
+            samples.extend(win_boundary[s])
+        elif s % fac_freq == 0:
+            samples.extend(win_fac)
+        else:
+            samples.extend(win_plain)
+    arr = np.asarray(samples, dtype=np.float64) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p95_ms": round(float(np.percentile(arr, 95)), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
 
 
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
@@ -321,7 +347,8 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         return _step
 
     if sgd_time is None:
-        t_sgd, sd_sgd, _ = _timeit(run_sgd, fresh_state(None), label=f"sgd{tag}")
+        t_sgd, sd_sgd, _, _ = _timeit(
+            run_sgd, fresh_state(None), label=f"sgd{tag}")
         print(f"sgd{tag} step: {t_sgd*1e3:.2f} ms ±{sd_sgd*1e3:.2f} "
               f"({batch/t_sgd:.1f} img/s)", file=sys.stderr)
     else:
@@ -332,15 +359,15 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
     # populate eigen state once so the plain variant preconditions real factors
     _log(f"kfac{tag}: compiling full (factors+eigen) step ...")
     s_kfac = run_kfac(True, True)(fresh_state(kfac))
-    t_plain, sd_plain, s_kfac = _timeit(
+    t_plain, sd_plain, win_plain, s_kfac = _timeit(
         run_kfac(False, False), s_kfac, label=f"kfac{tag} precond-only")
     rec.update(kfac_precond_ms=round(t_plain * 1e3, 3),
                kfac_precond_ms_std=round(sd_plain * 1e3, 3))
-    t_fac, sd_fac, s_kfac = _timeit(
+    t_fac, sd_fac, win_fac, s_kfac = _timeit(
         run_kfac(True, False), s_kfac, label=f"kfac{tag} +factors")
     rec.update(kfac_factors_ms=round(t_fac * 1e3, 3),
                kfac_factors_ms_std=round(sd_fac * 1e3, 3))
-    t_full, sd_full, s_kfac = _timeit(
+    t_full, sd_full, win_full, s_kfac = _timeit(
         run_kfac(True, True), s_kfac, warmup=1, iters=5, windows=2,
         label=f"kfac{tag} +eigen")
     print(
@@ -381,7 +408,77 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
             "factor": round((t_fac - t_plain) * 1e3, 3),
             "eigh": round((t_full - t_fac) * 1e3, 3),
         },
+        # per-step time distribution over one refresh interval: mean±std
+        # hides the eigen-step spike; it lives at max (and at p95 once
+        # kfac_update_freq ≤ 20)
+        step_time_ms=_schedule_stats(
+            win_plain, win_fac, [win_full], fac_freq, kfac_freq),
+        window_ms={
+            "precond": [round(t * 1e3, 3) for t in win_plain],
+            "factors": [round(t * 1e3, 3) for t in win_fac],
+            "eigen": [round(t * 1e3, 3) for t in win_full],
+        },
     )
+
+    chunks = int(kfac_kwargs.get("eigh_chunks", 1) or 1)
+    if chunks > 1:
+        # Pipelined-refresh arm: one timing per chunk-step program. Offsets
+        # mirror EigenRefreshCadence — chunk c runs at interval offset c, so
+        # it carries the factor-update flag iff the offset lands on
+        # fac_update_freq; the final chunk swaps the double buffer.
+        def run_chunk(c, swap):
+            uf = c % fac_freq == 0
+
+            def _step(state):
+                s, _ = kfac_step(state, (images, labels), lr, damping,
+                                 update_factors=uf, update_eigen=False,
+                                 eigen_chunk=(c, chunks), swap_eigen=swap)
+                return s
+
+            return _step
+
+        t_chunks, win_chunks = [], []
+        for c in range(chunks):
+            t_c, _, win_c, s_kfac = _timeit(
+                run_chunk(c, c == chunks - 1), s_kfac, warmup=1, iters=5,
+                windows=2, label=f"kfac{tag} chunk {c + 1}/{chunks}")
+            t_chunks.append(t_c)
+            win_chunks.append(win_c)
+            rec["kfac_chunk_ms"] = [round(t * 1e3, 3) for t in t_chunks]
+
+        sched = [
+            t_chunks[s] if s < chunks
+            else (t_fac if s % fac_freq == 0 else t_plain)
+            for s in range(kfac_freq)
+        ]
+        t_pipe = float(np.mean(sched))
+        pipe_overhead = (t_pipe - t_sgd) / t_sgd * 100.0
+        pipe_stats = _schedule_stats(
+            win_plain, win_fac, win_chunks, fac_freq, kfac_freq)
+        print(
+            f"kfac{tag} pipelined x{chunks}: worst chunk step "
+            f"{max(t_chunks)*1e3:.2f} ms vs monolithic eigen step "
+            f"{t_full*1e3:.2f} ms; amortized {t_pipe*1e3:.2f} ms "
+            f"→ overhead {pipe_overhead:.1f}%",
+            file=sys.stderr,
+        )
+        rec.update(
+            eigh_chunks=chunks,
+            kfac_chunk_max_ms=round(max(t_chunks) * 1e3, 3),
+            kfac_pipe_amortized_ms=round(t_pipe * 1e3, 3),
+            overhead_pipe_pct=round(pipe_overhead, 2),
+            # headline of the tentpole: the refresh spike (monolithic
+            # step_time_ms.max_ms) vs the pipelined max step
+            pipe_step_time_ms=pipe_stats,
+            spike_reduction_pct=round(
+                (1.0 - max(t_chunks) / t_full) * 100.0, 1),
+        )
+        # the pipelined schedule is the arm's real operating point — let the
+        # headline pick it up when it beats the monolithic amortization
+        if t_pipe < t_amort:
+            rec.update(kfac_amortized_ms=round(t_pipe * 1e3, 3),
+                       kfac_img_per_s_chip=round(batch / t_pipe, 1),
+                       overhead_pct=round(pipe_overhead, 2))
     return rec
 
 
@@ -422,7 +519,7 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
         s, _ = sgd_step(state, (tokens, targets), lr, damping)
         return s
 
-    t_sgd, sd_sgd, _ = _timeit(
+    t_sgd, sd_sgd, _, _ = _timeit(
         run_sgd, fresh_state(None), iters=10, label=f"lm-{attn_name} sgd")
     out = {
         "attention": attn_name,
@@ -449,13 +546,13 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
 
     _log(f"lm-{attn_name} kfac: compiling full step ...")
     s_kfac = run_kfac(True, True)(fresh_state(kfac))
-    t_plain, sd_plain, s_kfac = _timeit(
+    t_plain, sd_plain, win_plain, s_kfac = _timeit(
         run_kfac(False, False), s_kfac, iters=10,
         label=f"lm-{attn_name} kfac precond-only")
-    t_fac, sd_fac, s_kfac = _timeit(
+    t_fac, sd_fac, win_fac, s_kfac = _timeit(
         run_kfac(True, False), s_kfac, iters=5, windows=2,
         label=f"lm-{attn_name} kfac +factors")
-    t_full, sd_full, s_kfac = _timeit(
+    t_full, sd_full, win_full, s_kfac = _timeit(
         run_kfac(True, True), s_kfac, warmup=1, iters=3, windows=2,
         label=f"lm-{attn_name} kfac +eigen")
     t_amort = _amortized(t_plain, t_fac, t_full, fac_freq, kfac_freq)
@@ -476,6 +573,8 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
             "factor": round((t_fac - t_plain) * 1e3, 3),
             "eigh": round((t_full - t_fac) * 1e3, 3),
         },
+        "step_time_ms": _schedule_stats(
+            win_plain, win_fac, [win_full], fac_freq, kfac_freq),
     })
     return out
 
@@ -581,6 +680,11 @@ def main():
 
     arm_list = [
         ("f32", "", batch, None, {}, False),
+        # -pipe: the chunked/double-buffered refresh (KFAC(eigh_chunks=4)) at
+        # reference-parity numerics — measures the per-chunk step programs on
+        # top of the standard three and reports pipe_step_time_ms (p50/p95/
+        # max) vs the monolithic spike (docs/PERF.md "Refresh pipelining")
+        ("pipelined", "-pipe", batch, None, dict(eigh_chunks=4), True),
         ("inverse_aggressive", "-inv-aggr", batch, None, dict(inv_aggr), True),
         ("inverse_aggressive_b128", "-inv-aggr-b128", 128, None,
          dict(inv_aggr), False),

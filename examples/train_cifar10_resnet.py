@@ -29,8 +29,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, observability, runtime
-from kfac_pytorch_tpu.compile_cache import RecompileMonitor
+from kfac_pytorch_tpu import (
+    KFAC,
+    EigenRefreshCadence,
+    KFACParamScheduler,
+    observability,
+    runtime,
+)
+from kfac_pytorch_tpu.compile_cache import (
+    RecompileMonitor,
+    expected_step_variants,
+)
 from kfac_pytorch_tpu.models import cifar_resnet
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
@@ -44,7 +53,7 @@ from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training import data as data_lib
 from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
-from kfac_pytorch_tpu.training.step import kfac_flags_for_step, make_sgd
+from kfac_pytorch_tpu.training.step import make_sgd
 
 # per-step K-FAC health keys (beyond the original nu / min-eig pair) that
 # --kfac-diagnostics reduces to per-epoch means; names match
@@ -151,6 +160,12 @@ def parse_args(argv=None):
     p.add_argument("--eigen-dtype", default="f32", choices=["f32", "bf16"],
                    help="storage dtype of the eigenvector matrices (bf16 "
                         "halves the dominant precondition HBM stream)")
+    p.add_argument("--eigh-chunks", type=int, default=1,
+                   help="pipeline the eigen refresh over this many steps "
+                        "after each --kfac-update-freq boundary (double-"
+                        "buffered basis, swapped when all chunks land); 1 = "
+                        "monolithic refresh, bit-exact with prior releases "
+                        "(docs/PERF.md)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -243,6 +258,7 @@ def main(argv=None):
                                 if args.precond_comm_dtype == "bf16" else None),
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
             track_diagnostics=args.kfac_diagnostics,
+            eigh_chunks=args.eigh_chunks,
         )
         kfac_sched = KFACParamScheduler(
             kfac,
@@ -386,16 +402,17 @@ def main(argv=None):
         filename="telemetry.jsonl",
     )
     recompiles = RecompileMonitor(tel)
-    # legitimate variant counts: plain/factors/factors+eigen (×2 for the
-    # warmup-diag flag while a diag_warmup schedule is active)
-    recompiles.watch(
-        "train_step", train_step,
-        (3 if kfac.diag_warmup == 0 else 6) if kfac else 1,
-    )
+    # legitimate variant counts: plain/factors/factors+eigen — or the
+    # chunked-refresh set under --eigh-chunks — ×2 while a diag_warmup
+    # schedule is active (compile_cache.expected_step_variants)
+    recompiles.watch("train_step", train_step, expected_step_variants(kfac))
     recompiles.watch("eval_step", eval_step, 1)
     if bn_recal is not None:
         recompiles.watch("bn_recal", bn_recal, 1)
     step = int(jax.device_get(state.step))
+    # host-side refresh cadence: identical to kfac_flags_for_step at
+    # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
+    cadence = EigenRefreshCadence(kfac)
 
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
@@ -445,10 +462,12 @@ def main(argv=None):
                     break
                 lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
                 damping = kfac.hparams.damping if kfac else 0.0
-                flags = kfac_flags_for_step(step, kfac, epoch)
+                flags = cadence.flags_for_step(step, epoch)
                 with tel.span("comm/host_to_device"):
                     batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
-                if not flags.get("update_factors"):
+                if flags.get("eigen_chunk") is not None:
+                    sp = tel.span("step/eigen_chunk")
+                elif not flags.get("update_factors"):
                     sp = tel.span("step/plain")
                 elif flags.get("update_eigen"):
                     sp = tel.span("step/eigen")

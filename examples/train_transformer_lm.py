@@ -29,8 +29,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture, observability
-from kfac_pytorch_tpu.compile_cache import RecompileMonitor
+from kfac_pytorch_tpu import (
+    KFAC,
+    EigenRefreshCadence,
+    KFACParamScheduler,
+    capture,
+    observability,
+)
+from kfac_pytorch_tpu.compile_cache import (
+    RecompileMonitor,
+    expected_step_variants,
+)
 from kfac_pytorch_tpu.models import transformer_lm
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.parallel.context import make_context_parallel_attention
@@ -41,7 +50,6 @@ from kfac_pytorch_tpu.training import profiling
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
 from kfac_pytorch_tpu.training.step import (
     TrainState,
-    kfac_flags_for_step,
     make_eval_step,
     make_sgd,
     make_train_step,
@@ -82,6 +90,12 @@ def parse_args(argv=None):
                    help="precondition the token embedding too (diagonal-A "
                         "K-FAC; beyond the reference's Linear/Conv2d set)")
     p.add_argument("--kfac-update-freq", type=int, default=10, help="0 disables K-FAC")
+    p.add_argument("--eigh-chunks", type=int, default=1,
+                   help="pipeline the eigen refresh over this many steps "
+                        "after each --kfac-update-freq boundary (double-"
+                        "buffered basis, swapped when all chunks land); 1 = "
+                        "monolithic refresh, bit-exact with prior releases "
+                        "(docs/PERF.md)")
     p.add_argument("--kfac-cov-update-freq", type=int, default=1)
     p.add_argument("--stat-decay", type=float, default=0.95)
     p.add_argument("--damping", type=float, default=0.003)
@@ -180,6 +194,7 @@ def main(argv=None):
             kfac_update_freq=args.kfac_update_freq,
             mesh=mesh if devices.size > 1 else None,
             track_diagnostics=args.kfac_diagnostics,
+            eigh_chunks=args.eigh_chunks,
         )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
@@ -248,9 +263,12 @@ def main(argv=None):
         filename="telemetry.jsonl",
     )
     recompiles = RecompileMonitor(tel)
-    recompiles.watch("train_step", step_fn, 3 if kfac else 1)
+    recompiles.watch("train_step", step_fn, expected_step_variants(kfac))
     recompiles.watch("eval_step", eval_fn, 1)
     step = int(jax.device_get(state.step))
+    # host-side refresh cadence: identical to kfac_flags_for_step at
+    # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
+    cadence = EigenRefreshCadence(kfac)
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
@@ -271,8 +289,10 @@ def main(argv=None):
             for i, batch in enumerate(sharded_bptt_batches(stream)):
                 if i >= steps_per_epoch:
                     break
-                flags = kfac_flags_for_step(step, kfac, epoch)
-                if not flags.get("update_factors"):
+                flags = cadence.flags_for_step(step, epoch)
+                if flags.get("eigen_chunk") is not None:
+                    sp_t = tel.span("step/eigen_chunk")
+                elif not flags.get("update_factors"):
                     sp_t = tel.span("step/plain")
                 elif flags.get("update_eigen"):
                     sp_t = tel.span("step/eigen")

@@ -16,7 +16,7 @@ reference kfac/__init__.py:1-2):
 
 from kfac_pytorch_tpu import capture, ops
 from kfac_pytorch_tpu.preconditioner import KFAC, KFACHParams, KFACState
-from kfac_pytorch_tpu.scheduler import KFACParamScheduler
+from kfac_pytorch_tpu.scheduler import EigenRefreshCadence, KFACParamScheduler
 
 __version__ = "0.1.0"
 
@@ -25,6 +25,7 @@ __all__ = [
     "KFACHParams",
     "KFACState",
     "KFACParamScheduler",
+    "EigenRefreshCadence",
     "capture",
     "ops",
     "__version__",

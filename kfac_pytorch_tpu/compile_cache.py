@@ -47,6 +47,27 @@ def enable_persistent_cache(path: str | None = None) -> str:
     return path
 
 
+def expected_step_variants(kfac) -> int:
+    """Compile-budget for a K-FAC train step under the standard schedules.
+
+    The single source of truth the trainers hand to
+    :meth:`RecompileMonitor.watch`: with the monolithic refresh the schedule
+    produces plain / factors-only / factors+eigen programs; with the
+    pipelined refresh (``eigh_chunks = K > 1``) the eigen program is
+    replaced by up to ``K`` chunk programs, each of which may appear with
+    and without the factor-update flag (whether it does depends on how
+    ``fac_update_freq`` lands inside the chunk span, so this budgets the
+    bound), plus the one-time monolithic bootstrap refresh. A nonzero
+    ``diag_warmup`` doubles everything (each variant exists in warmup and
+    post-warmup form).
+    """
+    if kfac is None:
+        return 1
+    chunks = getattr(kfac, "eigh_chunks", 1)
+    base = 3 if chunks <= 1 else 3 + 2 * chunks
+    return base * (1 if kfac.diag_warmup == 0 else 2)
+
+
 class RecompileMonitor:
     """Watch jitted functions for trace-cache growth beyond expectations.
 

@@ -68,6 +68,72 @@ def precondition_assignment(
     return owners
 
 
+def plan_eigh_chunks(
+    slots,
+    chunks: int,
+    granularity: int = 512,
+    minimum: int = 128,
+) -> List[List[int]]:
+    """Partition eigh slots into ``chunks`` balanced pieces for the pipelined
+    refresh (one piece per post-boundary step).
+
+    Cost model is the padded eigh itself — ``bucket_size(slot)³`` — because
+    chunking exists to bound the per-step latency tax, and the tallest chunk
+    sets it. Greedy longest-processing-time over that cost; ties break on
+    (name, factor, start) then chunk index, so every host derives the same
+    plan from the same (layer set, diag_blocks, chunks) tuple and the chunk
+    id can be a static jit argument. Chunks may come back empty when there
+    are fewer slots than chunks — an empty chunk's step is just a plain step.
+    """
+    from kfac_pytorch_tpu.ops.eigh import bucket_size
+
+    cost = {
+        i: bucket_size(s.size, granularity, minimum) ** 3
+        for i, s in enumerate(slots)
+    }
+    order = sorted(
+        range(len(slots)),
+        key=lambda i: (-cost[i], slots[i].name, slots[i].factor, slots[i].start),
+    )
+    load = [0] * chunks
+    plan: List[List[int]] = [[] for _ in range(chunks)]
+    for i in order:
+        c = min(range(chunks), key=lambda c: (load[c], c))
+        plan[c].append(i)
+        load[c] += cost[i]
+    # stable downstream order (bucket grouping, owner tables) independent of
+    # the LPT visit order
+    return [sorted(p) for p in plan]
+
+
+def eigh_chunk_owners(
+    slots, world: int, granularity: int = 512, minimum: int = 128
+) -> List[int]:
+    """Per-slot owner devices for ONE chunk's slots, balanced over the mesh.
+
+    The full-refresh round-robin table balances across the whole slot set; a
+    chunk is a subset of it, so reusing those owners could pile a chunk's
+    work onto a few devices. Re-run greedy LPT (same ``bucket_size³`` cost
+    and deterministic tie-breaks as :func:`plan_eigh_chunks`) over just the
+    chunk's slots so each pipelined step spreads its eigh work across all
+    ``world`` devices.
+    """
+    from kfac_pytorch_tpu.ops.eigh import bucket_size
+
+    cost = [bucket_size(s.size, granularity, minimum) ** 3 for s in slots]
+    order = sorted(
+        range(len(slots)),
+        key=lambda i: (-cost[i], slots[i].name, slots[i].factor, slots[i].start),
+    )
+    load = [0] * world
+    owners = [0] * len(slots)
+    for i in order:
+        dev = min(range(world), key=lambda d: (load[d], d))
+        owners[i] = dev
+        load[dev] += cost[i]
+    return owners
+
+
 def layer_assignment(
     names: List[str],
     is_conv: Dict[str, bool],

@@ -223,6 +223,130 @@ def sharded_eigen_update(
     return _inner(factors)
 
 
+def _scatter_into(
+    pending: Dict[str, Dict[str, jnp.ndarray]],
+    slots: List[EighSlot],
+    results: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]],
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Scatter per-slot (Q, d) into an EXISTING eigen buffer dict.
+
+    The chunked-refresh analog of :func:`_assemble`: instead of starting from
+    zeroed buffers (a full refresh writes every slot), each chunk overwrites
+    only its own slots' block regions of the double-buffered
+    ``eigen_pending`` state, leaving other chunks' landed results in place.
+    Q casts to the buffer's storage dtype (``eigen_dtype``) at the write —
+    elementwise, so the swapped basis is bit-identical to the monolithic
+    path's whole-dict downcast.
+    """
+    out = {name: dict(e) for name, e in pending.items()}
+    for i, s in enumerate(slots):
+        q, d = results[i]
+        qk, dk = ("QA", "dA") if s.factor == "A" else ("QG", "dG")
+        buf = out[s.name][qk]
+        out[s.name][qk] = (
+            buf.at[s.start : s.stop, s.start : s.stop].set(q.astype(buf.dtype))
+        )
+        out[s.name][dk] = out[s.name][dk].at[s.start : s.stop].set(d)
+    return out
+
+
+def sharded_eigen_chunk_update(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    pending: Dict[str, Dict[str, jnp.ndarray]],
+    chunk_slots: List[EighSlot],
+    mesh: Mesh,
+    eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """One chunk of the pipelined refresh, sharded over the WHOLE mesh.
+
+    Same SPMD plan as :func:`sharded_eigen_update` — per-bucket index
+    tables, one batched eigh per bucket, sum-of-zeros psum — restricted to
+    ``chunk_slots`` and scattering results into the replicated ``pending``
+    buffers instead of assembling from zeros. Owners are rebalanced WITHIN
+    the chunk (``eigh_chunk_owners``) so each pipelined step spreads its
+    fraction of the eigh work across all devices.
+    """
+    from kfac_pytorch_tpu.parallel.assignment import eigh_chunk_owners
+
+    axes = tuple(mesh.axis_names)
+    world = mesh.devices.size
+    owners = eigh_chunk_owners(chunk_slots, world, granularity, minimum)
+    slots = [dataclasses.replace(s, owner=o) for s, o in zip(chunk_slots, owners)]
+    groups = _bucket_groups(slots, granularity, minimum)
+
+    tables = {}
+    for m, idxs in groups.items():
+        owned = [[r for r, i in enumerate(idxs) if slots[i].owner == dev] for dev in range(world)]
+        rows = max(1, max(len(o) for o in owned))
+        idx_tab = [(o + [0] * (rows - len(o))) for o in owned]
+        valid = [[1.0] * len(o) + [0.0] * (rows - len(o)) for o in owned]
+        tables[m] = (
+            jnp.asarray(idx_tab, jnp.int32),
+            jnp.asarray(valid, jnp.float32),
+        )
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=P(),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def _inner(facs):
+        tel = get_telemetry()
+        dev = lax.axis_index(axes[0])
+        for a in axes[1:]:
+            dev = dev * mesh.shape[a] + lax.axis_index(a)
+        per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        for m, idxs in groups.items():
+            with tel.span("trace/eigh/compute"):
+                all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
+                idx_tab, valid = tables[m]
+                mine = jnp.take(idx_tab, dev, axis=0)
+                vmask = jnp.take(valid, dev, axis=0)
+                stack = jnp.take(all_blocks, mine, axis=0)
+                q, d = batched_eigh(stack)
+                q = q * vmask[:, None, None]
+                d = d * vmask[:, None]
+            k = len(idxs)
+            with tel.span("trace/eigh/exchange"):
+                kq = jnp.zeros((k, m, m), jnp.float32).at[mine].add(q)
+                kd = jnp.zeros((k, m), jnp.float32).at[mine].add(d)
+                kq = lax.psum(kq, axes)
+                kd = lax.psum(kd, axes)
+            for row, i in enumerate(idxs):
+                per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
+        return per_slot
+
+    # the post-psum results are replicated, so the pending-buffer scatter can
+    # live outside the shard_map (identical program, simpler out pytree)
+    return _scatter_into(pending, slots, _inner(factors))
+
+
+def replicated_eigen_chunk_update(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    pending: Dict[str, Dict[str, jnp.ndarray]],
+    chunk_slots: List[EighSlot],
+    eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Single-device chunk path: the chunk's jobs, bucketed, scattered into
+    ``pending`` (the world=1 twin of :func:`sharded_eigen_chunk_update`)."""
+    from kfac_pytorch_tpu.ops.eigh import bucketed_eigh
+
+    blocks = [
+        factors[s.name][s.factor][s.start : s.stop, s.start : s.stop].astype(
+            jnp.float32
+        )
+        for s in chunk_slots
+    ]
+    results = bucketed_eigh(blocks, eps, granularity, minimum)
+    return _scatter_into(pending, chunk_slots, dict(enumerate(results)))
+
+
 def replicated_eigen_update(
     factors: Dict[str, Dict[str, jnp.ndarray]],
     diag_blocks_per_layer: Dict[str, int],

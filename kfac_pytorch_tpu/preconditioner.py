@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
@@ -43,10 +44,14 @@ from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
 from kfac_pytorch_tpu.parallel.assignment import (
     layer_assignment,
+    plan_eigh_chunks,
     precondition_assignment,
 )
 from kfac_pytorch_tpu.parallel.sharded_eigh import (
+    build_slots,
+    replicated_eigen_chunk_update,
     replicated_eigen_update,
+    sharded_eigen_chunk_update,
     sharded_eigen_update,
 )
 
@@ -112,6 +117,7 @@ class KFAC:
         eigen_dtype: Any = jnp.float32,
         precond_method: str = "eigen",
         track_diagnostics: bool = False,
+        eigh_chunks: int = 1,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -218,6 +224,20 @@ class KFAC:
                 "block-diagonal approximation"
             )
         self.precond_method = precond_method
+        # Pipelined curvature refresh: split the eigen refresh into this many
+        # static chunks spread over the steps after each kfac_update_freq
+        # boundary, double-buffered in state["eigen_pending"] and swapped in
+        # atomically once every chunk lands (scheduler.EigenRefreshCadence
+        # drives the cadence). 1 = today's monolithic refresh, bit-exact.
+        _validate("eigh chunk count", 0 < eigh_chunks, eigh_chunks)
+        if eigh_chunks > 1 and precond_method == "inverse":
+            raise ValueError(
+                "eigh_chunks > 1 pipelines the eigendecomposition refresh; "
+                "precond_method='inverse' refreshes via one batched Cholesky "
+                "~30x cheaper than the eigh it replaces — there is no spike "
+                "to spread, so refusing a config that implies one"
+            )
+        self.eigh_chunks = int(eigh_chunks)
         # Stability telemetry (costs two scalars of state + O(layers) mins):
         # ν — the KL trust-region coefficient actually applied each step
         # (kfac_preconditioner.py:320-326) — and the minimum damped
@@ -343,6 +363,14 @@ class KFAC:
             "eigen": singles,
             "eigen_stacked": stacked,
         }
+        if self.eigh_chunks > 1:
+            # Double buffer for the pipelined refresh: the accumulating
+            # eigenbasis in FULL per-layer form (chunks scatter block
+            # regions; the swap step re-splits into singles+stacked). Fixed
+            # from init — chunks=1 states carry no pending buffer, so the
+            # monolithic configuration's pytree (and checkpoints) are
+            # untouched.
+            state["eigen_pending"] = {n: dict(e) for n, e in eigen.items()}
         if self.track_diagnostics:
             # fixed from init so the state pytree structure never changes
             # (a mid-run structure flip would retrace the jitted step and
@@ -382,6 +410,8 @@ class KFAC:
         update_factors: bool,
         update_eigen: bool,
         diag_warmup_done: bool = True,
+        eigen_chunk: Optional[Tuple[int, int]] = None,
+        swap_eigen: bool = False,
     ) -> Tuple[PyTree, KFACState]:
         """One K-FAC step (kfac_preconditioner.py:336-408), functional.
 
@@ -396,6 +426,15 @@ class KFAC:
         clip used the construction-time lr). ``damping`` defaults to the
         scheduler-maintained ``hparams.damping``; pass both as traced scalars
         so schedules never recompile.
+
+        ``eigen_chunk``/``swap_eigen`` (STATIC, ``eigh_chunks > 1`` only)
+        drive the pipelined refresh: ``eigen_chunk=(c, k)`` runs chunk ``c``
+        of a ``k``-chunk plan into ``state["eigen_pending"]`` — this step
+        still preconditions with the ACTIVE basis — and ``swap_eigen=True``
+        on the final chunk's step promotes the completed pending basis
+        before preconditioning (the atomic swap). The cadence — including
+        the never-swap-a-partial-basis invariant — lives in
+        ``scheduler.EigenRefreshCadence``; callers should not hand-roll it.
         """
         if lr is None:
             raise ValueError(
@@ -404,6 +443,26 @@ class KFAC:
             )
         if damping is None:
             damping = self.hparams.damping
+        if eigen_chunk is not None:
+            if self.eigh_chunks <= 1:
+                raise ValueError(
+                    "eigen_chunk= requires KFAC(eigh_chunks > 1) — the state "
+                    "carries no eigen_pending double buffer to accumulate into"
+                )
+            if update_eigen:
+                raise ValueError(
+                    "eigen_chunk= and update_eigen=True are mutually "
+                    "exclusive: a step either pipelines one chunk or runs "
+                    "the monolithic refresh"
+                )
+            c, k = eigen_chunk
+            if not (0 < k and 0 <= c < k):
+                raise ValueError(f"Invalid eigen_chunk: {eigen_chunk}")
+        elif swap_eigen:
+            raise ValueError(
+                "swap_eigen=True without eigen_chunk=: the swap rides the "
+                "final chunk's step so the program count stays bounded"
+            )
         # The layer set was fixed at init() — state IS the source of truth,
         # so a heuristic/params mismatch cannot silently widen the set here.
         names = list(state["factors"].keys())
@@ -455,6 +514,7 @@ class KFAC:
 
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
+        pending = state.get("eigen_pending")
         # Per-layer eigenvalue spectra captured (pre-split) on eigen-update
         # steps for the health diagnostics; None on every other path.
         fresh_spectra = None
@@ -528,6 +588,64 @@ class KFAC:
                         for n, e in eigen.items()
                     }
                 eigen, stacked = precond_ops.split_eigen_state(eigen)
+        elif eigen_chunk is not None:
+            # Pipelined refresh: run this step's chunk of the eigh plan on
+            # the CURRENT factors into the pending double buffer. The plan is
+            # host-side static (deterministic LPT over the same slot set the
+            # monolithic refresh would build), so the chunk id selects a
+            # bounded set of compiled programs — one per (chunk, factors)
+            # combination — instead of retracing per layer.
+            c, k = eigen_chunk
+            diag_blocks = self.diag_blocks if diag_warmup_done else 1
+            world = self._world()
+            if world > 1:
+                table = layer_assignment(
+                    names,
+                    is_conv,
+                    world,
+                    self.distribute_layer_factors,
+                    diag_blocks,
+                )
+                slots = build_slots(facs, table)
+            else:
+                blocks = {
+                    name: (diag_blocks if is_conv[name] else 1) for name in names
+                }
+                slots = build_slots(facs, None, blocks)
+            chunk_slots = [slots[i] for i in plan_eigh_chunks(slots, k)[c]]
+            if c == 0:
+                # Fresh interval: zero the whole double buffer so the swap
+                # sees exactly what a from-zeros _assemble would build —
+                # off-block regions must not inherit a previous interval's
+                # values when diag_blocks (warmup) shifts block boundaries.
+                pending = jax.tree_util.tree_map(jnp.zeros_like, pending)
+            with tel.span("trace/kfac/eigh"):
+                if chunk_slots:
+                    if world > 1:
+                        pending = sharded_eigen_chunk_update(
+                            facs, pending, chunk_slots, self.mesh, self.eps
+                        )
+                    else:
+                        pending = replicated_eigen_chunk_update(
+                            facs, pending, chunk_slots, self.eps
+                        )
+            if swap_eigen:
+                # Atomic swap: every chunk has landed (EigenRefreshCadence
+                # guarantees it), so promote the pending basis and
+                # precondition THIS step with it — the pipelined analog of
+                # the monolithic refresh step. Embedding diagonal-A layers
+                # never go through eigh; their floored diagonal comes from
+                # the current factors exactly as the monolithic path does.
+                full = {n: dict(e) for n, e in pending.items()}
+                for n in names:
+                    if "A_diag" in facs[n]:
+                        d = facs[n]["A_diag"]
+                        full[n]["dA"] = d * (d > self.eps)
+                if self.track_diagnostics:
+                    fresh_spectra = {
+                        n: (full[n]["dA"], full[n]["dG"]) for n in names
+                    }
+                eigen, stacked = precond_ops.split_eigen_state(full)
 
         # Precondition every layer's gradient, every step
         # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
@@ -578,10 +696,12 @@ class KFAC:
             "eigen": eigen,
             "eigen_stacked": stacked,
         }
+        if pending is not None:
+            new_state["eigen_pending"] = pending
         if self.track_diagnostics:
             new_state["diagnostics"] = self._diagnostics(
                 state["diagnostics"], fresh_spectra, gmats, updates, nu,
-                damping, update_eigen,
+                damping, update_eigen or swap_eigen,
             )
         return new_grads, new_state
 

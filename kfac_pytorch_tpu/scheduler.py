@@ -6,6 +6,11 @@ preconditioner update frequencies, with ``start_epoch`` support for resume.
 It mutates the host-side ``KFACHParams`` — freqs drive host-side step-variant
 dispatch and damping enters the compiled step as a traced scalar, so a
 schedule change NEVER triggers recompilation.
+
+:class:`EigenRefreshCadence` lives here too: the host-side chunk cadence of
+the pipelined eigen refresh reads the SAME live ``KFACHParams`` this
+scheduler mutates, so a mid-run update-freq change re-plans the chunk
+schedule at the next interval boundary instead of fighting it.
 """
 
 from __future__ import annotations
@@ -93,3 +98,101 @@ class KFACParamScheduler:
         tel.set_gauge("kfac/damping", params.damping)
         tel.set_gauge("kfac/fac_update_freq", params.fac_update_freq)
         tel.set_gauge("kfac/kfac_update_freq", params.kfac_update_freq)
+
+
+class EigenRefreshCadence:
+    """Host-side step gating for the pipelined (chunked) eigen refresh.
+
+    The drop-in replacement for ``training.step.kfac_flags_for_step`` when
+    ``KFAC(eigh_chunks=K)``: call ``flags_for_step(step, epoch)`` every step
+    and splat the result into the jitted train step. With ``K == 1`` (or
+    ``kfac=None``) the produced flags are IDENTICAL to
+    ``kfac_flags_for_step`` — the monolithic schedule — so trainers can use
+    this class unconditionally.
+
+    With ``K > 1`` each ``kfac_update_freq`` boundary starts a refresh
+    interval: steps at offsets ``0..k_eff-1`` each run one chunk of the eigh
+    plan into ``state["eigen_pending"]`` (``k_eff = min(K,
+    kfac_update_freq)`` read from the LIVE hparams, so a
+    ``KFACParamScheduler`` freq change re-plans at the next boundary), and
+    the final chunk's step carries ``swap_eigen=True``. The invariant this
+    class owns: **swap only when every chunk of the current interval's plan
+    has landed.** A mid-interval plan change (update freq shrank below the
+    in-flight chunk count, diag-warmup flipped) abandons the partial pass —
+    the stale ``eigen_pending`` is simply overwritten from chunk 0 at the
+    next boundary, never swapped in, so the active basis is always complete.
+
+    The very first boundary runs the MONOLITHIC refresh (``update_eigen``)
+    instead of chunking: the init() eigenbasis is zeros, and pipelining the
+    first refresh would precondition the first ``K-1`` steps with it (zero
+    updates). After that bootstrap every refresh is chunked.
+    """
+
+    def __init__(self, kfac: Optional[KFAC], chunks: Optional[int] = None):
+        self.kfac = kfac
+        self.chunks = int(
+            chunks
+            if chunks is not None
+            else getattr(kfac, "eigh_chunks", 1) or 1
+        ) if kfac is not None else 1
+        if self.chunks > 1 and kfac is not None and kfac.eigh_chunks <= 1:
+            raise ValueError(
+                "EigenRefreshCadence(chunks > 1) needs KFAC(eigh_chunks > 1) "
+                "— the state carries no eigen_pending double buffer"
+            )
+        self._landed: set = set()
+        self._plan_key = None  # (k_eff, diag_warmup_done) of the open interval
+        self._last_refresh_step: Optional[int] = None
+        self._bootstrapped = False
+
+    def flags_for_step(self, step: int, epoch: Optional[int] = None) -> dict:
+        """Static flags for ``step`` (+ chunk-phase/staleness gauges)."""
+        if self.kfac is None:
+            return {"update_factors": False, "update_eigen": False}
+        tel = get_telemetry()
+        hp = self.kfac.hparams
+        warm = epoch is None or epoch >= self.kfac.diag_warmup
+        flags = {
+            "update_factors": step % hp.fac_update_freq == 0,
+            "update_eigen": False,
+            "diag_warmup_done": warm,
+        }
+        k_eff = max(1, min(self.chunks, hp.kfac_update_freq))
+        boundary = step % hp.kfac_update_freq == 0
+        chunk = None
+        if k_eff == 1:
+            flags["update_eigen"] = boundary
+            if boundary:
+                self._last_refresh_step = step
+                self._bootstrapped = True
+                self._landed = set()
+                self._plan_key = None
+        elif boundary and not self._bootstrapped:
+            flags["update_eigen"] = True
+            self._bootstrapped = True
+            self._last_refresh_step = step
+            self._landed = set()
+            self._plan_key = None
+        else:
+            offset = step % hp.kfac_update_freq
+            plan_key = (k_eff, warm)
+            if boundary:
+                self._landed = set()
+                self._plan_key = plan_key
+            if offset < k_eff and self._plan_key == plan_key:
+                chunk = offset
+                self._landed.add(offset)
+                swap = self._landed == set(range(k_eff))
+                flags["eigen_chunk"] = (chunk, k_eff)
+                flags["swap_eigen"] = swap
+                if swap:
+                    self._last_refresh_step = step
+        age = (
+            0
+            if self._last_refresh_step is None
+            else step - self._last_refresh_step
+        )
+        tel.set_gauge("kfac/eigh_chunks", k_eff)
+        tel.set_gauge("kfac/eigen_chunk_phase", -1 if chunk is None else chunk)
+        tel.set_gauge("kfac/eigen_basis_age_steps", age)
+        return flags

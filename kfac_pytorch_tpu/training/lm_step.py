@@ -150,6 +150,8 @@ def make_lm_train_step(
         update_factors: bool = False,
         update_eigen: bool = False,
         diag_warmup_done: bool = True,
+        eigen_chunk=None,
+        swap_eigen: bool = False,
     ):
         tokens, targets = batch  # [B, T] each
         carry = jax.lax.stop_gradient(carry)  # truncate BPTT at segment edge
@@ -179,6 +181,8 @@ def make_lm_train_step(
                 update_factors=update_factors,
                 update_eigen=update_eigen,
                 diag_warmup_done=diag_warmup_done,
+                eigen_chunk=eigen_chunk,
+                swap_eigen=swap_eigen,
             )
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -199,7 +203,13 @@ def make_lm_train_step(
 
     return jax.jit(
         train_step,
-        static_argnames=("update_factors", "update_eigen", "diag_warmup_done"),
+        static_argnames=(
+            "update_factors",
+            "update_eigen",
+            "diag_warmup_done",
+            "eigen_chunk",
+            "swap_eigen",
+        ),
         donate_argnames=("state",),
     )
 

@@ -395,6 +395,8 @@ def make_train_step(
         update_factors: bool = False,
         update_eigen: bool = False,
         diag_warmup_done: bool = True,
+        eigen_chunk=None,
+        swap_eigen: bool = False,
     ):
         images, labels = batch
         capture_stats = kfac is not None and update_factors
@@ -440,6 +442,8 @@ def make_train_step(
                 update_factors=update_factors,
                 update_eigen=update_eigen,
                 diag_warmup_done=diag_warmup_done,
+                eigen_chunk=eigen_chunk,
+                swap_eigen=swap_eigen,
             )
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -460,7 +464,13 @@ def make_train_step(
 
     return jax.jit(
         train_step,
-        static_argnames=("update_factors", "update_eigen", "diag_warmup_done"),
+        static_argnames=(
+            "update_factors",
+            "update_eigen",
+            "diag_warmup_done",
+            "eigen_chunk",
+            "swap_eigen",
+        ),
         donate_argnames=("state",),
     )
 

@@ -1,0 +1,306 @@
+"""Pipelined (chunked, double-buffered) eigen refresh: parity + bounds.
+
+The tentpole contract (docs/PERF.md "Refresh pipelining"): ``eigh_chunks=1``
+reproduces the monolithic schedule bitwise; ``eigh_chunks=K>1`` spreads the
+refresh over K chunk-step programs whose worst-case per-step FLOPs drop below
+the monolithic eigen step, at a bounded compile budget, and the host-side
+:class:`EigenRefreshCadence` never swaps in a partially-landed eigenbasis —
+even when a ``KFACParamScheduler`` changes ``kfac_update_freq`` mid-interval.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC, EigenRefreshCadence, KFACParamScheduler
+from kfac_pytorch_tpu.compile_cache import RecompileMonitor, expected_step_variants
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.training.step import kfac_flags_for_step
+
+from test_preconditioner import _dense_params, _stats_for
+
+
+def _leaves(tree):
+    return [
+        (jax.tree_util.keystr(p), np.asarray(x))
+        for p, x in jax.tree_util.tree_leaves_with_path(tree)
+    ]
+
+
+def _assert_bitwise(tree_a, tree_b, what):
+    la, lb = _leaves(tree_a), _leaves(tree_b)
+    assert [k for k, _ in la] == [k for k, _ in lb]
+    for (k, a), (_, b) in zip(la, lb):
+        np.testing.assert_array_equal(a, b, err_msg=f"{what}: {k}")
+
+
+def _apply(kfac, grads, state, a_c, g_s, flags):
+    return kfac.update(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=jnp.float32(0.1), damping=jnp.float32(0.003),
+        update_factors=flags["update_factors"],
+        update_eigen=flags["update_eigen"],
+        diag_warmup_done=flags.get("diag_warmup_done", True),
+        eigen_chunk=flags.get("eigen_chunk"),
+        swap_eigen=flags.get("swap_eigen", False),
+    )
+
+
+# ---------------------------------------------------------------------------
+# cadence (host-side, no compilation)
+
+
+def test_cadence_chunks1_matches_monolithic_flags():
+    """K=1 (and kfac=None) → flag-for-flag identical to kfac_flags_for_step,
+    so trainers can adopt the cadence unconditionally."""
+    kfac = KFAC(damping=0.003, fac_update_freq=3, kfac_update_freq=6)
+    cad = EigenRefreshCadence(kfac)
+    for step in range(20):
+        want = kfac_flags_for_step(step, kfac, epoch=None)
+        got = cad.flags_for_step(step)
+        assert got == want, f"step {step}: {got} != {want}"
+    assert EigenRefreshCadence(None).flags_for_step(0) == kfac_flags_for_step(
+        0, None
+    )
+
+
+def test_cadence_chunk_sequence_and_bootstrap():
+    kfac = KFAC(damping=0.003, fac_update_freq=4, kfac_update_freq=4,
+                eigh_chunks=3)
+    cad = EigenRefreshCadence(kfac)
+    seq = [cad.flags_for_step(s) for s in range(9)]
+    # step 0: monolithic bootstrap (init eigenbasis is zeros — chunking it
+    # would precondition K-1 steps with zero updates)
+    assert seq[0]["update_eigen"] and "eigen_chunk" not in seq[0]
+    # steps 1-3: plain (no chunk work before the next boundary)
+    for s in (1, 2, 3):
+        assert not seq[s]["update_eigen"] and "eigen_chunk" not in seq[s]
+    # steps 4-6: the pipelined interval — chunks 0,1,2 then swap
+    assert [seq[s].get("eigen_chunk") for s in (4, 5, 6)] == [
+        (0, 3), (1, 3), (2, 3)
+    ]
+    assert [seq[s].get("swap_eigen") for s in (4, 5, 6)] == [False, False, True]
+    for s in (4, 5, 6):
+        assert not seq[s]["update_eigen"]
+    # factor cadence is untouched by chunking
+    assert [seq[s]["update_factors"] for s in range(9)] == [
+        s % 4 == 0 for s in range(9)
+    ]
+
+
+def _drive(cad, kfac, scheduler_step_at=None, scheduler=None, n=40):
+    """Run the cadence; assert the swap invariant at every step; return the
+    step indices that swapped."""
+    swaps, landed = [], set()
+    for step in range(n):
+        if scheduler_step_at is not None and step == scheduler_step_at:
+            scheduler.step(1)  # mid-interval hparam change
+        flags = cad.flags_for_step(step)
+        ec = flags.get("eigen_chunk")
+        if ec is None:
+            landed_now = None
+        else:
+            c, k = ec
+            assert 0 <= c < k <= kfac.eigh_chunks
+            if c == 0:
+                landed = set()
+            landed.add(c)
+            landed_now = (landed, k)
+        if flags.get("swap_eigen"):
+            # the invariant: a swap only ever rides the completion of a full
+            # chunk pass under ONE plan
+            assert landed_now is not None
+            assert landed_now[0] == set(range(landed_now[1]))
+            swaps.append(step)
+    return swaps
+
+
+def test_cadence_freq_shrink_mid_interval():
+    """kfac_update_freq shrinking below the in-flight chunk count must not
+    strand eigen_pending: the partial pass is abandoned (never swapped) and
+    the clamped plan completes at a later boundary."""
+    kfac = KFAC(damping=0.003, fac_update_freq=1, kfac_update_freq=8,
+                eigh_chunks=4)
+    sched = KFACParamScheduler(kfac, update_freq_alpha=0.25,
+                               update_freq_schedule=[1])
+    cad = EigenRefreshCadence(kfac)
+    # freq drops 8 → 2 at step 9: one chunk of the (0..3, k=4) pass has
+    # landed (step 8) and can never complete
+    swaps = _drive(cad, kfac, scheduler_step_at=9, scheduler=sched, n=24)
+    assert kfac.hparams.kfac_update_freq == 2
+    assert swaps, "clamped plan never completed a refresh"
+    # post-change k_eff is clamped to the new freq
+    flags = cad.flags_for_step(24)
+    ec = flags.get("eigen_chunk")
+    assert ec is not None and ec[1] == 2
+
+
+def test_cadence_freq_growth_mid_interval():
+    """Freq growth mid-interval: the open pass is re-keyed, nothing swaps
+    until a full pass lands under the new plan."""
+    kfac = KFAC(damping=0.003, fac_update_freq=1, kfac_update_freq=2,
+                eigh_chunks=4)
+    sched = KFACParamScheduler(kfac, update_freq_alpha=4.0,
+                               update_freq_schedule=[1])
+    cad = EigenRefreshCadence(kfac)
+    swaps = _drive(cad, kfac, scheduler_step_at=3, scheduler=sched, n=32)
+    assert kfac.hparams.kfac_update_freq == 8
+    assert swaps, "grown plan never completed a refresh"
+
+
+# ---------------------------------------------------------------------------
+# numerics
+
+
+def test_chunks1_bitwise_parity_sharded():
+    """eigh_chunks=1 is the monolithic path, bit for bit, on the 8-device
+    mesh: same state pytree structure, same eigenbasis, same updates."""
+    mesh = data_parallel_mesh()
+    assert mesh.devices.size == 8
+    rng = np.random.RandomState(0)
+    params = _dense_params(rng, (12, 16, 8))
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    base = KFAC(damping=0.003, fac_update_freq=2, kfac_update_freq=4,
+                mesh=mesh)
+    pipe = KFAC(damping=0.003, fac_update_freq=2, kfac_update_freq=4,
+                mesh=mesh, eigh_chunks=1)
+    cad = EigenRefreshCadence(pipe)
+
+    s_base, s_pipe = base.init(params), pipe.init(params)
+    for step in range(6):
+        f_base = kfac_flags_for_step(step, base)
+        f_pipe = cad.flags_for_step(step)
+        g_base, s_base = _apply(base, grads, s_base, a_c, g_s, f_base)
+        g_pipe, s_pipe = _apply(pipe, grads, s_pipe, a_c, g_s, f_pipe)
+        _assert_bitwise(g_base, g_pipe, f"grads step {step}")
+        _assert_bitwise(s_base, s_pipe, f"state step {step}")
+
+
+def test_frozen_factor_chunked_matches_monolithic():
+    """With factors frozen across the interval (fac_update_freq ==
+    kfac_update_freq) every chunk sees the same curvature, so the pipelined
+    refresh lands the monolithic eigenbasis exactly."""
+    rng = np.random.RandomState(1)
+    params = _dense_params(rng, (10, 14, 6))
+    a_c, g_s, grads = _stats_for(params, rng)
+
+    mono = KFAC(damping=0.003, fac_update_freq=4, kfac_update_freq=4)
+    pipe = KFAC(damping=0.003, fac_update_freq=4, kfac_update_freq=4,
+                eigh_chunks=3)
+    cad_m = EigenRefreshCadence(mono)
+    cad_p = EigenRefreshCadence(pipe)
+
+    s_m, s_p = mono.init(params), pipe.init(params)
+    for step in range(8):
+        g_m, s_m = _apply(mono, grads, s_m, a_c, g_s,
+                          cad_m.flags_for_step(step))
+        g_p, s_p = _apply(pipe, grads, s_p, a_c, g_s,
+                          cad_p.flags_for_step(step))
+    # step 7 preconditions with the post-swap basis on the chunked side and
+    # the step-4 monolithic basis on the other — identical factors, so
+    # identical eigenbasis and identical updates
+    _assert_bitwise(g_m, g_p, "preconditioned grads")
+    _assert_bitwise(s_m["eigen"], s_p["eigen"], "eigen")
+    _assert_bitwise(s_m["eigen_stacked"], s_p["eigen_stacked"],
+                    "eigen_stacked")
+
+
+# ---------------------------------------------------------------------------
+# compile + FLOPs budgets (replicated path: same host-side dispatch logic,
+# CPU-affordable compiles)
+
+
+def _jit_update(kfac):
+    @partial(jax.jit, static_argnames=("update_factors", "update_eigen",
+                                       "eigen_chunk", "swap_eigen"))
+    def step(grads, state, a_c, g_s, *, update_factors=False,
+             update_eigen=False, eigen_chunk=None, swap_eigen=False):
+        return kfac.update(
+            grads, state, a_contribs=a_c, g_factor_stats=g_s,
+            lr=jnp.float32(0.1), damping=jnp.float32(0.003),
+            update_factors=update_factors, update_eigen=update_eigen,
+            eigen_chunk=eigen_chunk, swap_eigen=swap_eigen,
+        )
+
+    return step
+
+
+def _flops(compiled):
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0]
+    return float(cost["flops"])
+
+
+def test_chunk_step_flops_below_monolithic():
+    """The point of the tentpole: the worst chunk step does strictly less
+    eigh work than the monolithic refresh step (two shape buckets, so the
+    LPT chunk plan splits real work, not padding)."""
+    rng = np.random.RandomState(2)
+    params = _dense_params(rng, (64, 192, 32))  # buckets {128, 512}
+    a_c, g_s, grads = _stats_for(params, rng)
+    kfac = KFAC(damping=0.003, fac_update_freq=4, kfac_update_freq=4,
+                eigh_chunks=2)
+    state = kfac.init(params)
+    step = _jit_update(kfac)
+
+    mono = _flops(step.lower(grads, state, a_c, g_s, update_factors=True,
+                             update_eigen=True).compile())
+    chunk_flops = []
+    for c in range(2):
+        chunk_flops.append(_flops(step.lower(
+            grads, state, a_c, g_s, update_factors=(c == 0),
+            eigen_chunk=(c, 2), swap_eigen=(c == 1),
+        ).compile()))
+    assert max(chunk_flops) < mono, (chunk_flops, mono)
+
+
+def test_retrace_bound_full_interval():
+    """Compile-count regression: one full chunked interval compiles at most
+    len(bucket_groups) + chunks new programs (here 2 buckets + 2 chunks),
+    the second interval compiles ZERO, and the total stays inside the
+    expected_step_variants budget the trainers hand to RecompileMonitor."""
+    rng = np.random.RandomState(3)
+    params = _dense_params(rng, (64, 192, 32))  # 2 shape buckets
+    a_c, g_s, grads = _stats_for(params, rng)
+    chunks = 2
+    kfac = KFAC(damping=0.003, fac_update_freq=4, kfac_update_freq=4,
+                eigh_chunks=chunks)
+    cad = EigenRefreshCadence(kfac)
+    step = _jit_update(kfac)
+    mon = RecompileMonitor(telemetry=None)
+    mon.watch("kfac_update", step, expected_step_variants(kfac))
+
+    state = kfac.init(params)
+
+    def run(lo, hi, st):
+        for s in range(lo, hi):
+            flags = cad.flags_for_step(s)
+            _, st = _apply_jitted(step, grads, st, a_c, g_s, flags)
+        return st
+
+    def _apply_jitted(step, grads, st, a_c, g_s, flags):
+        return step(grads, st, a_c, g_s,
+                    update_factors=flags["update_factors"],
+                    update_eigen=flags["update_eigen"],
+                    eigen_chunk=flags.get("eigen_chunk"),
+                    swap_eigen=flags.get("swap_eigen", False))
+
+    # warm: bootstrap (factors+eigen), plain, factors-only — the monolithic
+    # working set
+    state = run(0, 4, state)
+    warm = int(step._cache_size())
+    # one full chunked interval (steps 4..7): chunk 0 (+factors), chunk 1
+    # (+swap), then plain steps
+    state = run(4, 8, state)
+    first = int(step._cache_size())
+    n_buckets = 2
+    assert first - warm <= n_buckets + chunks, (warm, first)
+    # steady state: the second interval re-uses every program
+    state = run(8, 12, state)
+    assert int(step._cache_size()) == first
+    assert mon.check() == {}, "compile budget regression"
