@@ -286,6 +286,25 @@ def _schedule_stats(win_plain, win_fac, win_boundary, fac_freq, kfac_freq):
     }
 
 
+def _compiled_memory(lowered):
+    """XLA-reported memory of one compiled step program.
+
+    ``temp_size_in_bytes`` is the allocator's scratch high-water mark — the
+    number the fused factor kernel shrinks (a materialized im2col patch
+    tensor lives there, docs/PERF.md "Factor-statistics memory").
+    ``memory_analysis()`` is best-effort per backend, so failures degrade to
+    an error note instead of killing the arm."""
+    try:
+        stats = lowered.compile().memory_analysis()
+        return {
+            "temp_bytes": int(stats.temp_size_in_bytes),
+            "argument_bytes": int(stats.argument_size_in_bytes),
+            "output_bytes": int(stats.output_size_in_bytes),
+        }
+    except Exception as e:  # noqa: BLE001 — backend-dependent reporting
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
                  kfac_kwargs=None, sgd_time=None, rec=None):
     """Measure SGD + the three K-FAC step variants for one configuration.
@@ -338,6 +357,14 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
     kfac = KFAC(damping=0.001, fac_update_freq=fac_freq,
                 kfac_update_freq=kfac_freq, **kfac_kwargs)
     kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+
+    # Compiled-memory report for the factor-update step — the arm's peak
+    # footprint (the b128 lever is memory-bound, not FLOP-bound). Streamed
+    # into the record before any timing so a watchdog snapshot keeps it.
+    rec["memory"] = _compiled_memory(
+        kfac_step.lower(fresh_state(kfac), (images, labels), lr, damping,
+                        update_factors=True, update_eigen=False))
+    _log(f"kfac{tag} +factors compiled memory: {rec['memory']}")
 
     def run_kfac(uf, ue):
         def _step(state):
@@ -662,10 +689,20 @@ def main():
             # publish the live record FIRST: a watchdog/SIGTERM snapshot
             # mid-arm keeps every timing that already landed
             _ARMS[key] = {}
+            # reuse_sgd: True → the f32 arm's SGD baseline; a key string →
+            # that arm's (same-batch, same-dtype) baseline; False → measure
+            if reuse_sgd is True:
+                sgd_time = sgd_f32[0]
+            elif reuse_sgd:
+                src = _ARMS.get(reuse_sgd, {})
+                sgd_time = ((src["sgd_ms"] / 1e3, src["sgd_ms_std"] / 1e3)
+                            if "sgd_ms" in src else None)
+            else:
+                sgd_time = None
             _measure_arm(
                 arm_batch, size, fac_freq, kfac_freq, dtype=dtype, tag=tag,
                 kfac_kwargs=kwargs,
-                sgd_time=sgd_f32[0] if reuse_sgd else None,
+                sgd_time=sgd_time,
                 rec=_ARMS[key],
             )
             if key == "f32":
@@ -688,6 +725,11 @@ def main():
         ("inverse_aggressive", "-inv-aggr", batch, None, dict(inv_aggr), True),
         ("inverse_aggressive_b128", "-inv-aggr-b128", 128, None,
          dict(inv_aggr), False),
+        # the tentpole arm: batch 128 with the fused Pallas patch-covariance
+        # kernel — compare its `memory.temp_bytes` against the b128 arm above
+        # (dense im2col) to see the materialization the kernel removes
+        ("inverse_aggressive_b128_kernel", "-b128-kernel", 128, None,
+         dict(inv_aggr, factor_kernel="pallas"), "inverse_aggressive_b128"),
         # b64 insurance: if the b128 arm OOMs or stalls in compile on the
         # chip, the batch lever is still demonstrated at half scale
         ("inverse_aggressive_b64", "-inv-aggr-b64", 64, None,

@@ -166,6 +166,12 @@ def parse_args(argv=None):
                         "buffered basis, swapped when all chunks land); 1 = "
                         "monolithic refresh, bit-exact with prior releases "
                         "(docs/PERF.md)")
+    p.add_argument("--factor-kernel", default="auto",
+                   choices=["auto", "pallas", "dense"],
+                   help="conv A-factor statistics kernel: pallas = fused "
+                        "patch-covariance Pallas kernel (no im2col patch "
+                        "tensor, enables large batches; docs/PERF.md), dense "
+                        "= im2col oracle, auto = pallas on TPU else dense")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -259,6 +265,7 @@ def main(argv=None):
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
             track_diagnostics=args.kfac_diagnostics,
             eigh_chunks=args.eigh_chunks,
+            factor_kernel=args.factor_kernel,
         )
         kfac_sched = KFACParamScheduler(
             kfac,

@@ -130,6 +130,12 @@ def parse_args(argv=None):
     p.add_argument("--eigen-dtype", default="f32", choices=["f32", "bf16"],
                    help="storage dtype of the eigenvector matrices (bf16 "
                         "halves the dominant precondition HBM stream)")
+    p.add_argument("--factor-kernel", default="auto",
+                   choices=["auto", "pallas", "dense"],
+                   help="conv A-factor statistics kernel: pallas = fused "
+                        "patch-covariance Pallas kernel (no im2col patch "
+                        "tensor, enables large batches; docs/PERF.md), dense "
+                        "= im2col oracle, auto = pallas on TPU else dense")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -214,6 +220,7 @@ def main(argv=None):
             precond_comm_dtype=(jnp.bfloat16
                                 if args.precond_comm_dtype == "bf16" else None),
             eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
+            factor_kernel=args.factor_kernel,
         )
 
     state = TrainState(

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from kfac_pytorch_tpu.models.layers import A_CONTRIB, OUT_PERTURB
-from kfac_pytorch_tpu.ops import factors
+from kfac_pytorch_tpu.ops import factor_kernels, factors
 
 PyTree = Any
 
@@ -111,7 +111,13 @@ def discover_layers(model, *args, **kwargs) -> List[str]:
     """
     from kfac_pytorch_tpu.models.layers import KFAC_ACTS
 
-    shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs))
+    # Shape-only trace: pin the dense A path — the fused Pallas kernel's
+    # interpreter lowering (a grid scan) would bloat this throwaway jaxpr,
+    # and both kernels sow identical shapes by construction.
+    with factor_kernels.factor_kernel_scope("dense"):
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs)
+        )
     return layer_names_from_capture(shapes.get(KFAC_ACTS, {}))
 
 
@@ -326,8 +332,11 @@ def perturbation_zeros(model, *args, **kwargs) -> PyTree:
     """
     from kfac_pytorch_tpu.models.layers import PERTURBATIONS
 
-    shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs)
-    )
+    # Dense-pinned for the same reason as discover_layers: this eval_shape
+    # runs inside every captured step trace, and only shapes are kept.
+    with factor_kernels.factor_kernel_scope("dense"):
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), *args, **kwargs)
+        )
     perts = shapes[PERTURBATIONS]
     return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), perts)

@@ -34,7 +34,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax import lax
 
-from kfac_pytorch_tpu.ops import factors
+from kfac_pytorch_tpu.ops import factor_kernels, factors
 
 Dtype = Any
 Padding = Union[str, int, Sequence[Tuple[int, int]]]
@@ -204,9 +204,13 @@ class KFACConv(_KFACLayer):
             bias = None
 
         padding = _normalize_padding(self.padding)
+        # Conv A contributions route through the factor-kernel dispatcher:
+        # dense im2col oracle by default, the fused Pallas patch-covariance
+        # kernel when the train step opened a "pallas" scope
+        # (KFAC(factor_kernel=...), ops/factor_kernels.py).
         if groups == 1:
             self._sow_a(
-                lambda: factors.compute_a_conv(
+                lambda: factor_kernels.dispatch_compute_a_conv(
                     x.astype(jnp.float32),
                     self.kernel_size,
                     self.strides,
@@ -217,7 +221,7 @@ class KFACConv(_KFACLayer):
             )
         else:
             self._sow_a(
-                lambda: factors.compute_a_conv_grouped(
+                lambda: factor_kernels.dispatch_compute_a_conv_grouped(
                     x.astype(jnp.float32),
                     groups,
                     self.kernel_size,

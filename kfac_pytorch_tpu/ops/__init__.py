@@ -21,6 +21,14 @@ from kfac_pytorch_tpu.ops.factors import (
     mat_to_grads,
     update_running_avg,
 )
+from kfac_pytorch_tpu.ops.factor_kernels import (
+    FACTOR_KERNELS,
+    active_factor_kernel,
+    compute_a_conv_fused,
+    compute_a_conv_grouped_fused,
+    factor_kernel_scope,
+    resolve_factor_kernel,
+)
 from kfac_pytorch_tpu.ops.eigh import (
     blocked_eigh,
     eigh_with_floor,
@@ -44,6 +52,12 @@ __all__ = [
     "mat_to_dense_kernel",
     "mat_to_grads",
     "update_running_avg",
+    "FACTOR_KERNELS",
+    "active_factor_kernel",
+    "compute_a_conv_fused",
+    "compute_a_conv_grouped_fused",
+    "factor_kernel_scope",
+    "resolve_factor_kernel",
     "blocked_eigh",
     "eigh_with_floor",
     "get_block_boundary",

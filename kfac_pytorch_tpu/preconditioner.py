@@ -40,6 +40,7 @@ from jax.sharding import Mesh
 
 from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.ops import factor_kernels as factor_kernel_ops
 from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
 from kfac_pytorch_tpu.parallel.assignment import (
@@ -118,6 +119,7 @@ class KFAC:
         precond_method: str = "eigen",
         track_diagnostics: bool = False,
         eigh_chunks: int = 1,
+        factor_kernel: str = "auto",
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -247,6 +249,20 @@ class KFAC:
         # the trust region is not catching it. Eigen method only (the
         # inverse method never materializes eigenvalues).
         self.track_diagnostics = track_diagnostics
+        # Conv A-factor statistics kernel: "dense" is the im2col oracle
+        # (ops/factors.py::compute_a_conv, kept verbatim), "pallas" the fused
+        # patch-covariance kernel that never materializes the im2col tensor
+        # (ops/factor_kernels.py — ~kh·kw× less factor-step HBM traffic, the
+        # batch-128 lever of docs/PERF.md). "auto" resolves here: pallas on
+        # TPU, dense elsewhere (CPU/GPU run the kernel only in interpret
+        # mode, which is a test vehicle, not a fast path). Train steps open
+        # a factor_kernel_scope with this value around their capture forward.
+        _validate(
+            "factor_kernel",
+            factor_kernel in factor_kernel_ops.FACTOR_KERNELS,
+            factor_kernel,
+        )
+        self.factor_kernel = factor_kernel_ops.resolve_factor_kernel(factor_kernel)
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,

@@ -20,6 +20,7 @@ import optax
 from kfac_pytorch_tpu import capture, compat
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
 from kfac_pytorch_tpu.observability.diagnostics import diagnostic_metrics
+from kfac_pytorch_tpu.ops import factor_kernels
 from kfac_pytorch_tpu.preconditioner import KFAC
 from kfac_pytorch_tpu.training.step import (
     TrainState,
@@ -61,47 +62,53 @@ def make_lm_train_step(
     def _compute(params, tokens, targets, carry, dropout_rng, capture_stats):
         rngs = {"dropout": dropout_rng}
         if capture_stats:
-            perts = capture.perturbation_zeros(model, tokens, train=True)
+            # Trace-time factor-kernel scope, same as training/step.py —
+            # any conv layer in an LM stack (e.g. conv frontends) routes its
+            # A contribution through the configured kernel.
+            with factor_kernels.factor_kernel_scope(kfac.factor_kernel):
+                return _compute_captured(params, tokens, targets, carry, rngs)
 
-            def loss_fn(params, perts):
-                (logits, new_carry), mut = model.apply(
-                    {"params": params, PERTURBATIONS: perts},
-                    tokens,
-                    carry=carry,
-                    train=True,
-                    mutable=[KFAC_ACTS],
-                    rngs=rngs,
-                )
-                loss = softmax_cross_entropy(
-                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-                )
-                return loss, (mut, new_carry)
-
-            (loss, (mut, new_carry)), (grads, gperts) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True
-            )(params, perts)
-            names = (
-                kfac.layers
-                if kfac.layers is not None
-                else capture.layer_names_from_capture(mut[KFAC_ACTS])
+        def loss_fn(params):
+            logits, new_carry = model.apply(
+                {"params": params}, tokens, carry=carry, train=True, rngs=rngs
             )
-            a_c = capture.a_contribs(mut[KFAC_ACTS], names)
-            g_s = capture.g_factors(gperts, names, batch_averaged=kfac.batch_averaged)
-        else:
-
-            def loss_fn(params):
-                logits, new_carry = model.apply(
-                    {"params": params}, tokens, carry=carry, train=True, rngs=rngs
-                )
-                loss = softmax_cross_entropy(
-                    logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
-                )
-                return loss, new_carry
-
-            (loss, new_carry), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params
+            loss = softmax_cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
             )
-            a_c = g_s = None
+            return loss, new_carry
+
+        (loss, new_carry), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        return loss, grads, None, None, new_carry
+
+    def _compute_captured(params, tokens, targets, carry, rngs):
+        perts = capture.perturbation_zeros(model, tokens, train=True)
+
+        def loss_fn(params, perts):
+            (logits, new_carry), mut = model.apply(
+                {"params": params, PERTURBATIONS: perts},
+                tokens,
+                carry=carry,
+                train=True,
+                mutable=[KFAC_ACTS],
+                rngs=rngs,
+            )
+            loss = softmax_cross_entropy(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1)
+            )
+            return loss, (mut, new_carry)
+
+        (loss, (mut, new_carry)), (grads, gperts) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(params, perts)
+        names = (
+            kfac.layers
+            if kfac.layers is not None
+            else capture.layer_names_from_capture(mut[KFAC_ACTS])
+        )
+        a_c = capture.a_contribs(mut[KFAC_ACTS], names)
+        g_s = capture.g_factors(gperts, names, batch_averaged=kfac.batch_averaged)
         return loss, grads, a_c, g_s, new_carry
 
     def _compute_compressed(params, tokens, targets, carry, dropout_rng,

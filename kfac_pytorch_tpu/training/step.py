@@ -27,6 +27,7 @@ from jax import lax
 from kfac_pytorch_tpu import capture, compat
 from kfac_pytorch_tpu.models.layers import KFAC_ACTS, PERTURBATIONS
 from kfac_pytorch_tpu.observability.diagnostics import diagnostic_metrics
+from kfac_pytorch_tpu.ops import factor_kernels
 from kfac_pytorch_tpu.preconditioner import KFAC
 
 PyTree = Any
@@ -250,6 +251,15 @@ def make_train_step(
         )
 
     def loss_and_grads_captured(params, batch_stats, images, labels):
+        # Trace-time scope: the KFACConv layers inside model.apply route
+        # their A contributions through the configured factor kernel
+        # (ops/factor_kernels.py) — "pallas" skips the im2col temporary.
+        with factor_kernels.factor_kernel_scope(
+            kfac.factor_kernel if kfac is not None else "dense"
+        ):
+            return _loss_and_grads_captured(params, batch_stats, images, labels)
+
+    def _loss_and_grads_captured(params, batch_stats, images, labels):
         perts = capture.perturbation_zeros(model, images, **train_kwargs)
         has_bn = bool(batch_stats)
         mutable = (["batch_stats"] if has_bn else []) + [KFAC_ACTS]

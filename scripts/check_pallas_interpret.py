@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Lint: every Pallas kernel in ops/ is exercised by an interpret-mode test.
+
+Tier-1 runs on CPU, where TPU Pallas kernels only execute through the
+interpreter (``interpret=True``) — a kernel nobody calls that way is a
+kernel whose math tier-1 silently stopped checking. For each module under
+``kfac_pytorch_tpu/ops/`` this walks the AST, finds the functions that
+invoke ``pallas_call``, climbs the intra-module call graph to the public
+(non-underscore) entry points that reach them, and requires at least one
+of those entry names to appear in a ``tests/*.py`` file that also contains
+``interpret=True``.
+
+Also fails on a *dead* kernel: a ``pallas_call``-bearing function no
+public function of its module reaches.
+
+Exit 0 clean, 1 with a report otherwise. Run from the repo root (tier-1
+wraps it in a test, tests/test_scripts.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+OPS = ROOT / "kfac_pytorch_tpu" / "ops"
+TESTS = ROOT / "tests"
+
+
+def _function_calls(tree: ast.Module) -> dict:
+    """module-level function name -> set of bare names it calls."""
+    calls = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Name):
+                    names.add(f.id)
+                elif isinstance(f, ast.Attribute):
+                    names.add(f.attr)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                # plain name loads too: kernels are usually passed as values
+                # (pl.pallas_call(_kernel, ...), functools.partial(_kernel)),
+                # not called directly — an over-approximation that can only
+                # make the lint more lenient about "dead", never miss a
+                # missing test
+                names.add(sub.id)
+        calls[node.name] = names
+    # module-level autodiff registration: `fn.defvjp(fwd, bwd)` /
+    # `fn.defjvp(...)` makes the rule functions reachable through `fn`
+    for node in tree.body:
+        call = node.value if isinstance(node, ast.Expr) else None
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("defvjp", "defjvp")
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id in calls
+        ):
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    calls[call.func.value.id].add(arg.id)
+    return calls
+
+
+def _kernel_entry_points(path: pathlib.Path):
+    """(functions containing pallas_call, public entry names reaching them)."""
+    tree = ast.parse(path.read_text())
+    calls = _function_calls(tree)
+    kernel_fns = {
+        name for name, used in calls.items() if "pallas_call" in used
+    }
+    if not kernel_fns:
+        return set(), {}
+
+    # climb: which module functions (transitively) reach a kernel fn
+    reaches = {name: set(used) & set(calls) for name, used in calls.items()}
+    reaching = set(kernel_fns)
+    changed = True
+    while changed:
+        changed = False
+        for name, used in reaches.items():
+            if name not in reaching and used & reaching:
+                reaching.add(name)
+                changed = True
+
+    entries = {}
+    for k in sorted(kernel_fns):
+        pub = sorted(
+            n for n in reaching
+            if not n.startswith("_")
+            and (n == k or _reaches(n, k, reaches))
+        )
+        entries[k] = pub
+    return kernel_fns, entries
+
+
+def _reaches(src: str, dst: str, graph: dict, _seen=None) -> bool:
+    seen = _seen or set()
+    if src in seen:
+        return False
+    seen.add(src)
+    for nxt in graph.get(src, ()):
+        if nxt == dst or _reaches(nxt, dst, graph, seen):
+            return True
+    return False
+
+
+def main() -> int:
+    interpret_tests = [
+        p for p in sorted(TESTS.glob("*.py"))
+        if "interpret=True" in p.read_text()
+    ]
+    test_text = {p: p.read_text() for p in interpret_tests}
+
+    problems = []
+    checked = 0
+    for mod in sorted(OPS.glob("*.py")):
+        kernel_fns, entries = _kernel_entry_points(mod)
+        rel = mod.relative_to(ROOT)
+        for k in sorted(kernel_fns):
+            checked += 1
+            pub = entries[k]
+            if not pub:
+                problems.append(
+                    f"{rel}: kernel {k!r} is unreachable from any public "
+                    "function of its module (dead kernel)"
+                )
+                continue
+            hits = [
+                str(p.relative_to(ROOT))
+                for p, text in test_text.items()
+                if any(name in text for name in pub)
+            ]
+            if not hits:
+                problems.append(
+                    f"{rel}: kernel {k!r} (entries: {', '.join(pub)}) has no "
+                    "interpret-mode test — no tests/*.py with interpret=True "
+                    "references an entry point"
+                )
+
+    if problems:
+        print(
+            f"check_pallas_interpret: {len(problems)} problem(s)",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(
+        f"check_pallas_interpret: OK — {checked} Pallas kernel(s) covered by "
+        f"{len(interpret_tests)} interpret-mode test file(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,18 @@ def test_make_code_corpus(tmp_path):
     assert splits["train"].dtype == np.int32
 
 
+def test_pallas_interpret_lint_clean():
+    """Every Pallas kernel in ops/ must stay covered by an interpret-mode
+    test — otherwise CPU tier-1 silently stops checking its math
+    (scripts/check_pallas_interpret.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_pallas_interpret.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_summarize_curves_compare_fallback(tmp_path):
     """--compare falls back to a shared lower-is-better tag when the runs
     have no val/accuracy (LM logs), and counts wins with <= semantics."""
