@@ -147,6 +147,16 @@ def parse_args(argv=None):
                         "on the wire (the reference's --fp16-allreduce on "
                         "DistributedOptimizer, pytorch_cifar10_resnet.py:"
                         "190-195); None = exact f32 reduction")
+    p.add_argument("--factor-comm-dtype", default="f32",
+                   choices=["f32", "bf16"],
+                   help="wire dtype of the bucketed K-FAC factor-statistics "
+                        "exchange (parallel/comm.py); f32 = bitwise parity "
+                        "with the per-layer exchange")
+    p.add_argument("--factor-comm-freq", type=int, default=1,
+                   help="allreduce factor statistics every N capture steps "
+                        "instead of every one (merged running averages, "
+                        "always flushed before an eigen refresh); 1 = "
+                        "per-step exchange, exact")
     p.add_argument("--precond-method", default="eigen",
                    choices=["eigen", "inverse"],
                    help="eigen: reference-parity eigenbasis solve (damping "
@@ -266,6 +276,8 @@ def main(argv=None):
             track_diagnostics=args.kfac_diagnostics,
             eigh_chunks=args.eigh_chunks,
             factor_kernel=args.factor_kernel,
+            factor_comm_dtype=args.factor_comm_dtype,
+            factor_comm_freq=args.factor_comm_freq,
         )
         kfac_sched = KFACParamScheduler(
             kfac,

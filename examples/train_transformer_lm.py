@@ -107,6 +107,16 @@ def parse_args(argv=None):
                         "on the wire (the reference's --fp16-allreduce on "
                         "DistributedOptimizer); pure-DP only "
                         "(--seq-parallel 1)")
+    p.add_argument("--factor-comm-dtype", default="f32",
+                   choices=["f32", "bf16"],
+                   help="wire dtype of the bucketed K-FAC factor-statistics "
+                        "exchange (parallel/comm.py); pure-DP only "
+                        "(--seq-parallel 1); f32 = bitwise parity with the "
+                        "per-layer exchange")
+    p.add_argument("--factor-comm-freq", type=int, default=1,
+                   help="allreduce factor statistics every N capture steps "
+                        "(merged running averages, always flushed before an "
+                        "eigen refresh); pure-DP only; 1 = per-step, exact")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--telemetry-dir", default=None,
@@ -195,6 +205,8 @@ def main(argv=None):
             mesh=mesh if devices.size > 1 else None,
             track_diagnostics=args.kfac_diagnostics,
             eigh_chunks=args.eigh_chunks,
+            factor_comm_dtype=args.factor_comm_dtype,
+            factor_comm_freq=args.factor_comm_freq,
         )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
@@ -220,6 +232,12 @@ def main(argv=None):
             "--grad-comm-dtype requires a pure data-parallel mesh "
             "(--seq-parallel 1): a sequence axis would make the per-device "
             "local forward see a partial example"
+        )
+    if (args.factor_comm_dtype != "f32" or args.factor_comm_freq > 1) and sp > 1:
+        raise SystemExit(
+            "--factor-comm-dtype/--factor-comm-freq require a pure "
+            "data-parallel mesh (--seq-parallel 1): the factor exchange "
+            "rides the same explicit-collective wrapper as --grad-comm-dtype"
         )
     step_fn = make_train_step(
         model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip,

@@ -252,6 +252,29 @@ def g_factors(
     return out
 
 
+def factor_stat_tree(
+    a_contribs: Dict[str, jnp.ndarray], g_stats: Dict[str, jnp.ndarray]
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Join the per-layer A and G stat dicts into ONE canonical pytree.
+
+    The wire format of the factor-communication plane (parallel/comm.py):
+    planning/flattening over the joint tree lets A and G leaves of different
+    layers share buckets, and the fixed {"a": ..., "g": ...} framing keeps
+    the flattened leaf order — and therefore the bucket layout — identical
+    on every host. Handles every leaf shape capture produces: dense/conv
+    ``[a, a]``/``[g, g]`` matrices and embedding diagonal-A ``[vocab]``
+    vectors.
+    """
+    return {"a": a_contribs, "g": g_stats}
+
+
+def split_factor_stat_tree(
+    tree: Dict[str, Dict[str, jnp.ndarray]]
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Inverse of :func:`factor_stat_tree`."""
+    return tree["a"], tree["g"]
+
+
 def grad_mats(
     lgrads: Dict[str, Dict[str, jnp.ndarray]]
 ) -> Dict[str, jnp.ndarray]:

@@ -60,11 +60,20 @@ def expected_step_variants(kfac) -> int:
     bound), plus the one-time monolithic bootstrap refresh. A nonzero
     ``diag_warmup`` doubles everything (each variant exists in warmup and
     post-warmup form).
+
+    Deferred factor reduction (``factor_comm_freq > 1`` on a multi-device
+    mesh) splits the capture variants by the ``flush_factors`` flag: the
+    monolithic schedule adds one program (factors-without-flush; the eigen
+    step always flushes), the pipelined schedule two (the factors-only and
+    chunk-0 programs each gain a flush twin).
     """
     if kfac is None:
         return 1
     chunks = getattr(kfac, "eigh_chunks", 1)
     base = 3 if chunks <= 1 else 3 + 2 * chunks
+    comm = getattr(kfac, "factor_comm", None)
+    if comm is not None and comm.defer:
+        base += 1 if chunks <= 1 else 2
     return base * (1 if kfac.diag_warmup == 0 else 2)
 
 

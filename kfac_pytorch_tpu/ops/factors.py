@@ -231,6 +231,35 @@ def update_running_avg(
     return alpha * current + (1.0 - alpha) * new
 
 
+def merge_running_avg_buckets(
+    bufs: Sequence[jnp.ndarray], axis_name: str, comm_dtype=None
+) -> list:
+    """Uniform-weight cross-replica merge of locally-accumulated EMA buckets.
+
+    The deferred-factor-communication merge (DP-KFAC, arxiv 2206.15143),
+    exact for lockstep replicas because :func:`update_running_avg` is linear
+    in its contributions: after ``m`` local updates from a synced value
+    ``F0``, replica ``r`` holds
+
+        F_r = α^m·F0 + (1−α)·Σ_j α^(m−1−j)·c_j^(r)
+
+    so the replica mean ``(1/R)·Σ_r F_r`` carries exactly the weight
+    ``(1−α)·α^(m−1−j)`` on step j's *mean* contribution — the same weighted
+    combination a per-step reduction of the ``c_j`` would have produced.
+    Deferral moves WHEN factor traffic crosses the wire, not what the
+    running averages converge to. Operates on the comm plane's flat wire
+    buckets (parallel/comm.py); ``comm_dtype`` (e.g. bf16) casts only the
+    wire payload, each result is restored to its bucket's dtype. With
+    ``comm_dtype=None`` the pmean is bitwise what per-leaf f32 pmeans of the
+    same values produce (the reduction is elementwise either way).
+    """
+    out = []
+    for buf in bufs:
+        wire = buf if comm_dtype is None else buf.astype(comm_dtype)
+        out.append(lax.pmean(wire, axis_name).astype(buf.dtype))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Factor-space <-> parameter-space reshapes
 # ---------------------------------------------------------------------------

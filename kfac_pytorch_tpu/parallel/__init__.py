@@ -12,7 +12,9 @@ zero-masked buffers — the reference's "allgather via sum of zeros" trick
 from kfac_pytorch_tpu.parallel.assignment import (
     RoundRobin,
     layer_assignment,
+    plan_factor_buckets,
 )
+from kfac_pytorch_tpu.parallel.comm import FactorComm
 from kfac_pytorch_tpu.parallel.context import (
     full_attention,
     make_context_parallel_attention,
@@ -25,6 +27,8 @@ from kfac_pytorch_tpu.parallel.sharded_eigh import sharded_eigen_update
 __all__ = [
     "RoundRobin",
     "layer_assignment",
+    "plan_factor_buckets",
+    "FactorComm",
     "data_parallel_mesh",
     "sharded_eigen_update",
     "full_attention",

@@ -11,8 +11,9 @@ than being communicated.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class RoundRobin:
@@ -160,3 +161,77 @@ def layer_assignment(
         ranks_g = rr.next(n) if distribute_layer_factors else ranks_a
         table[name] = {"A": ranks_a, "G": ranks_g}
     return table
+
+
+# ---------------------------------------------------------------------------
+# Factor-communication wire buckets (parallel/comm.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorBucketEntry:
+    """One stat leaf's slice of a wire bucket.
+
+    ``index`` is the leaf's position in the flattened stat tree (jax pytree
+    traversal order, identical on every host); ``offset``/``size`` locate its
+    flat payload inside the bucket buffer; ``shape`` restores it.
+    """
+
+    index: int
+    offset: int
+    size: int
+    shape: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorBucket:
+    """One flat wire buffer: a static slice layout over stat leaves."""
+
+    entries: Tuple[FactorBucketEntry, ...]
+    size: int
+
+
+def plan_factor_buckets(
+    shapes: Sequence[Tuple[int, ...]], max_bucket_elems: int = 1 << 20
+) -> Tuple[FactorBucket, ...]:
+    """Pack factor-stat leaves into a small static set of flat wire buckets.
+
+    The tensor-fusion layout of the factor-communication plane (SPD-KFAC,
+    arxiv 2107.06533): instead of one collective per layer per factor, every
+    per-layer A/G stat leaf gets a slice of a handful of flat buffers and one
+    collective moves each buffer. Greedy first-fit in flattened-tree order —
+    NOT size-sorted like the LPT planners above, because there is no load to
+    balance here: the leaf order is already deterministic across hosts, and
+    keeping tree neighbors adjacent keeps the concat/slice reshapes around
+    the collective local. A bucket closes when the next leaf would push it
+    past ``max_bucket_elems`` (default 1 Mi elements = 4 MiB at f32 —
+    comfortably above any single factor in the model zoo, so small models
+    fuse into ONE bucket); a single oversized leaf still gets its own bucket
+    rather than splitting. Pure shape metadata: the comm plane caches the
+    plan per stat-tree signature at trace time and every step variant shares
+    it.
+    """
+    if max_bucket_elems < 1:
+        raise ValueError(f"Invalid max_bucket_elems: {max_bucket_elems}")
+    buckets: List[FactorBucket] = []
+    entries: List[FactorBucketEntry] = []
+    offset = 0
+    for index, shape in enumerate(shapes):
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if entries and offset + size > max_bucket_elems:
+            buckets.append(FactorBucket(entries=tuple(entries), size=offset))
+            entries, offset = [], 0
+        entries.append(
+            FactorBucketEntry(
+                index=index,
+                offset=offset,
+                size=size,
+                shape=tuple(int(d) for d in shape),
+            )
+        )
+        offset += size
+    if entries:
+        buckets.append(FactorBucket(entries=tuple(entries), size=offset))
+    return tuple(buckets)

@@ -1,0 +1,233 @@
+"""Factor-communication plane: bucketed, compressed, deferrable allreduce.
+
+The reference exchanges K-FAC factor statistics with one allreduce per layer
+per factor (kfac_preconditioner.py:410-419 — an ``hvd.allreduce`` for every
+A and every G), and the train steps here reproduced that faithfully: each
+capture step issued a separate f32 ``lax.pmean`` per layer per factor inside
+the compressed-grad ``shard_map``. This module replaces those per-layer
+pmeans with one plane owning all three wire levers:
+
+* **Tensor fusion** — every per-layer A/G stat leaf flattens into a small
+  static set of flat buckets (``parallel.assignment.plan_factor_buckets``)
+  and ONE collective moves each bucket (SPD-KFAC, arxiv 2107.06533: fused
+  factor communication is the dominant distributed-K-FAC lever once compute
+  is optimized). ``scripts/check_collective_count.py`` pins the compiled
+  capture step to ≤ bucket-count factor all-reduces.
+* **Wire compression** — ``KFAC(factor_comm_dtype="bf16")`` casts only the
+  bucket payload for the wire; the f32 running-average master copy on device
+  is untouched (the factor-side mirror of ``training.step.pmean_compressed``).
+* **Deferred reduction** — ``KFAC(factor_comm_freq=N)`` skips the per-step
+  contribution reduction entirely: every replica EMAs its LOCAL statistics,
+  and the merged running averages cross the wire only every N capture steps
+  and always immediately before an eigen refresh (DP-KFAC, arxiv 2206.15143:
+  locally-averaged factors suffice between refreshes). The merge itself is
+  ``ops.factors.merge_running_avg_buckets`` — exact for lockstep replicas
+  because the EMA is linear in its contributions.
+
+Escape hatches: every knob defaults to the pre-plane behavior. With
+``factor_comm_dtype="f32"`` and ``factor_comm_freq=1`` on a single device
+(or without a mesh) the plane is inert and the train step's program is
+untouched; inside the compressed-grad wrapper the f32 bucketed mean is
+bitwise-identical to the per-layer pmeans it replaced
+(tests/test_factor_comm.py pins both, with
+:func:`per_layer_pmean_reference` kept as the oracle).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from kfac_pytorch_tpu import capture, compat
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.ops import factors as factor_ops
+from kfac_pytorch_tpu.parallel.assignment import (
+    FactorBucket,
+    plan_factor_buckets,
+)
+
+PyTree = Any
+
+_F32 = np.dtype(np.float32)
+
+
+def flatten_buckets(
+    leaves: List[jnp.ndarray], plan: Tuple[FactorBucket, ...]
+) -> List[jnp.ndarray]:
+    """Pack stat leaves into the plan's flat wire buffers."""
+    bufs = []
+    for bucket in plan:
+        parts = [leaves[e.index].reshape(-1) for e in bucket.entries]
+        bufs.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return bufs
+
+
+def unflatten_buckets(
+    bufs: List[jnp.ndarray],
+    plan: Tuple[FactorBucket, ...],
+    like_leaves: List[jnp.ndarray],
+) -> List[jnp.ndarray]:
+    """Slice bucket buffers back into leaves (inverse of flatten_buckets).
+
+    ``like_leaves`` supplies leaves for any index the plan does not cover —
+    the plan always covers all of them, but taking the template makes the
+    round-trip contract explicit and testable.
+    """
+    out = list(like_leaves)
+    for bucket, buf in zip(plan, bufs):
+        for e in bucket.entries:
+            out[e.index] = buf[e.offset : e.offset + e.size].reshape(e.shape)
+    return out
+
+
+def per_layer_pmean_reference(tree: PyTree, axis_name: str) -> PyTree:
+    """The pre-plane wire op — one f32 pmean per stat leaf.
+
+    Kept (unused by production code) as the parity oracle: the bucketed f32
+    path must stay bitwise-identical to this (tests/test_factor_comm.py).
+    """
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+class FactorComm:
+    """The factor-statistics exchange plane of one ``KFAC`` instance.
+
+    Owns the static bucket layout (cached per stat-tree signature), the wire
+    dtype, and the deferral policy. Two entry points:
+
+    * :meth:`exchange_contribs` — the per-capture-step exchange, called
+      INSIDE the train step's ``shard_map`` where the reduction axis is
+      bound. Deferred mode makes it a no-op (statistics stay local).
+    * :meth:`flush` — the deferred-mode merge of the per-replica factor
+      running averages, called from ``KFAC.update`` in the GSPMD region
+      (it opens its own replicated ``shard_map``).
+
+    Trace-time wire accounting lands in the ``kfac/factor_wire_bytes`` and
+    ``kfac/factor_collectives`` gauges (docs/OBSERVABILITY.md) and on
+    ``last_wire_bytes``/``last_collectives`` for host-side readers (bench).
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        axis_name: str = "data",
+        comm_dtype: Any = jnp.float32,
+        comm_freq: int = 1,
+        max_bucket_elems: int = 1 << 20,
+    ):
+        if int(comm_freq) < 1:
+            raise ValueError(f"Invalid factor_comm_freq: {comm_freq}")
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.comm_dtype = np.dtype(comm_dtype)
+        self.comm_freq = int(comm_freq)
+        self.max_bucket_elems = int(max_bucket_elems)
+        self.last_wire_bytes: Optional[int] = None
+        self.last_collectives: Optional[int] = None
+        self._plans: Dict[Any, Tuple[FactorBucket, ...]] = {}
+
+    # -- policy ---------------------------------------------------------
+
+    @property
+    def multi_device(self) -> bool:
+        return self.mesh is not None and self.mesh.devices.size > 1
+
+    @property
+    def defer(self) -> bool:
+        """Deferred reduction on: statistics accumulate locally between
+        flushes. Requires the KFAC mesh (flush opens a shard_map over it)."""
+        return self.comm_freq > 1 and self.multi_device
+
+    @property
+    def active(self) -> bool:
+        """True when the plane changes the wire vs. the defaults — the train
+        steps then route the capture computation through the explicit-
+        collective wrapper even without ``grad_comm_dtype``."""
+        return self.multi_device and (self.defer or self.comm_dtype != _F32)
+
+    # -- plan -----------------------------------------------------------
+
+    def _plan_for(self, leaves: List[jnp.ndarray]) -> Tuple[FactorBucket, ...]:
+        key = tuple(tuple(leaf.shape) for leaf in leaves)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = plan_factor_buckets(
+                [leaf.shape for leaf in leaves], self.max_bucket_elems
+            )
+            self._plans[key] = plan
+        wire = sum(b.size for b in plan) * self.comm_dtype.itemsize
+        tel = get_telemetry()
+        tel.set_gauge("kfac/factor_wire_bytes", wire)
+        tel.set_gauge("kfac/factor_collectives", len(plan))
+        self.last_wire_bytes = wire
+        self.last_collectives = len(plan)
+        return plan
+
+    # -- wire ops -------------------------------------------------------
+
+    def allreduce(self, tree: PyTree, axis_name: Optional[str] = None) -> PyTree:
+        """Bucketed cross-replica mean of a stat pytree.
+
+        Must run where ``axis_name`` is bound (inside a ``shard_map``). The
+        flatten/concat around the collective are trace-time reshapes XLA
+        folds into the buffer layout; the mean itself (with the optional
+        wire downcast) is ``ops.factors.merge_running_avg_buckets``.
+        """
+        axis = axis_name or self.axis_name
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        with get_telemetry().span("trace/kfac/factor_comm"):
+            plan = self._plan_for(leaves)
+            wire_dtype = None if self.comm_dtype == _F32 else self.comm_dtype
+            bufs = flatten_buckets(leaves, plan)
+            bufs = factor_ops.merge_running_avg_buckets(bufs, axis, wire_dtype)
+            leaves = unflatten_buckets(bufs, plan, leaves)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def exchange_contribs(
+        self,
+        a_contribs: Dict[str, jnp.ndarray],
+        g_stats: Dict[str, jnp.ndarray],
+        axis_name: str,
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Per-capture-step exchange point inside the train step's shard_map.
+
+        Fuses the A and G dicts into one stat tree so both factors share
+        buckets. Deferred mode returns the LOCAL statistics unchanged —
+        each replica's running averages then evolve independently until
+        :meth:`flush` merges them.
+        """
+        if self.defer:
+            return a_contribs, g_stats
+        tree = capture.factor_stat_tree(a_contribs, g_stats)
+        tree = self.allreduce(tree, axis_name)
+        return capture.split_factor_stat_tree(tree)
+
+    def flush(self, facs: PyTree) -> PyTree:
+        """Merge the per-replica factor running averages (deferred mode).
+
+        Runs in the GSPMD region of the jitted step: between flushes the
+        factors are *annotated* fully-replicated but physically diverged
+        (every device EMA'd its own local contributions — elementwise ops on
+        replicated arrays execute per-device, no collective resyncs them),
+        so a ``shard_map`` with replicated specs hands each device its own
+        copy and one bucketed pmean produces the uniform-weight merge.
+        """
+        if not self.defer:
+            raise ValueError(
+                "FactorComm.flush() requires deferred factor communication "
+                "(factor_comm_freq > 1 with a multi-device KFAC mesh)"
+            )
+        fn = partial(
+            compat.shard_map,
+            mesh=self.mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+            check_vma=False,
+        )(lambda tree: self.allreduce(tree, self.axis_name))
+        return fn(facs)

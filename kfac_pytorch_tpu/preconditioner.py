@@ -48,6 +48,7 @@ from kfac_pytorch_tpu.parallel.assignment import (
     plan_eigh_chunks,
     precondition_assignment,
 )
+from kfac_pytorch_tpu.parallel.comm import FactorComm
 from kfac_pytorch_tpu.parallel.sharded_eigh import (
     build_slots,
     replicated_eigen_chunk_update,
@@ -120,6 +121,8 @@ class KFAC:
         track_diagnostics: bool = False,
         eigh_chunks: int = 1,
         factor_kernel: str = "auto",
+        factor_comm_dtype: Any = "f32",
+        factor_comm_freq: int = 1,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -263,6 +266,48 @@ class KFAC:
             factor_kernel,
         )
         self.factor_kernel = factor_kernel_ops.resolve_factor_kernel(factor_kernel)
+        # Factor-communication plane (parallel/comm.py): bucketed fusion of
+        # the per-layer A/G stat exchange, optional bf16 wire compression,
+        # optional deferred reduction every `factor_comm_freq` capture steps
+        # (flushed before every eigen refresh). Defaults are the parity
+        # escape hatch: f32 + freq 1 leaves the step's numerics bitwise
+        # unchanged, and without a multi-device mesh the plane is inert.
+        if isinstance(factor_comm_dtype, str):
+            _FACTOR_COMM_DTYPES = {
+                "f32": jnp.float32,
+                "float32": jnp.float32,
+                "bf16": jnp.bfloat16,
+                "bfloat16": jnp.bfloat16,
+            }
+            _validate(
+                "factor_comm_dtype",
+                factor_comm_dtype.lower() in _FACTOR_COMM_DTYPES,
+                factor_comm_dtype,
+            )
+            factor_comm_dtype = _FACTOR_COMM_DTYPES[factor_comm_dtype.lower()]
+        _validate(
+            "factor_comm_freq",
+            isinstance(factor_comm_freq, int) and 0 < factor_comm_freq,
+            factor_comm_freq,
+        )
+        self.factor_comm = FactorComm(
+            mesh=mesh,
+            axis_name=axis_name,
+            comm_dtype=factor_comm_dtype,
+            comm_freq=factor_comm_freq,
+        )
+        if (
+            factor_comm_freq > 1 or self.factor_comm.comm_dtype != jnp.dtype("float32")
+        ) and not self.factor_comm.multi_device:
+            # Mirrors the distribute_precondition warning above: not an
+            # error — trainers pass the same flags to 1-device dev runs —
+            # but the knobs shape a cross-replica exchange that does not
+            # exist here, so say so up front.
+            print(
+                "WARNING: factor_comm_dtype/factor_comm_freq shape the "
+                "cross-replica factor exchange and have no effect without a "
+                "multi-device mesh= — factor statistics stay local and exact"
+            )
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -387,6 +432,15 @@ class KFAC:
             # monolithic configuration's pytree (and checkpoints) are
             # untouched.
             state["eigen_pending"] = {n: dict(e) for n, e in eigen.items()}
+        if self.factor_comm.defer:
+            # Deferred factor communication: the factor running averages
+            # double as per-replica LOCAL accumulators between flushes (no
+            # extra buffers — the EMA's linearity makes the flush-time mean
+            # of local EMAs exact, see ops.factors.merge_running_avg_buckets).
+            # This counter tracks capture steps since the last cross-replica
+            # merge (0 == globally synced); fixed from init so the state
+            # pytree structure never changes mid-run.
+            state["factor_sync_age"] = jnp.zeros((), jnp.int32)
         if self.track_diagnostics:
             # fixed from init so the state pytree structure never changes
             # (a mid-run structure flip would retrace the jitted step and
@@ -428,6 +482,7 @@ class KFAC:
         diag_warmup_done: bool = True,
         eigen_chunk: Optional[Tuple[int, int]] = None,
         swap_eigen: bool = False,
+        flush_factors: bool = False,
     ) -> Tuple[PyTree, KFACState]:
         """One K-FAC step (kfac_preconditioner.py:336-408), functional.
 
@@ -451,6 +506,14 @@ class KFAC:
         before preconditioning (the atomic swap). The cadence — including
         the never-swap-a-partial-basis invariant — lives in
         ``scheduler.EigenRefreshCadence``; callers should not hand-roll it.
+
+        ``flush_factors`` (STATIC, deferred factor communication only, i.e.
+        ``factor_comm_freq > 1`` on a multi-device mesh) merges the
+        per-replica locally-accumulated factor running averages across the
+        mesh — after this step's EMA, before any eigen work reads them. The
+        cadence helpers set it every ``factor_comm_freq``-th capture step
+        and on every step that starts an eigen refresh; ``update()`` refuses
+        a refresh that would read unmerged local factors.
         """
         if lr is None:
             raise ValueError(
@@ -479,6 +542,22 @@ class KFAC:
                 "swap_eigen=True without eigen_chunk=: the swap rides the "
                 "final chunk's step so the program count stays bounded"
             )
+        if flush_factors and not self.factor_comm.defer:
+            raise ValueError(
+                "flush_factors=True without deferred factor communication "
+                "(factor_comm_freq > 1 on a multi-device mesh) — there is "
+                "no locally-accumulated factor state to merge"
+            )
+        if self.factor_comm.defer and not flush_factors:
+            if update_eigen or (eigen_chunk is not None and eigen_chunk[0] == 0):
+                raise ValueError(
+                    "deferred factor communication requires flush_factors="
+                    "True on every step that starts an eigen refresh — the "
+                    "eigendecomposition would otherwise read per-replica "
+                    "unmerged factors. The cadence helpers "
+                    "(kfac_flags_for_step / EigenRefreshCadence) set this; "
+                    "hand-rolled schedules must too."
+                )
         # The layer set was fixed at init() — state IS the source of truth,
         # so a heuristic/params mismatch cannot silently widen the set here.
         names = list(state["factors"].keys())
@@ -527,6 +606,11 @@ class KFAC:
                     }
                     for name in names
                 }
+        if flush_factors:
+            # Deferred-mode merge of the per-replica running averages —
+            # AFTER this step's EMA (so the flush includes it), BEFORE any
+            # eigen path below reads the factors.
+            facs = self.factor_comm.flush(facs)
 
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
@@ -714,6 +798,12 @@ class KFAC:
         }
         if pending is not None:
             new_state["eigen_pending"] = pending
+        if "factor_sync_age" in state:
+            new_state["factor_sync_age"] = (
+                jnp.zeros((), jnp.int32)
+                if flush_factors
+                else state["factor_sync_age"] + int(update_factors)
+            )
         if self.track_diagnostics:
             new_state["diagnostics"] = self._diagnostics(
                 state["diagnostics"], fresh_spectra, gmats, updates, nu,

@@ -187,6 +187,19 @@ class EigenRefreshCadence:
                 flags["swap_eigen"] = swap
                 if swap:
                     self._last_refresh_step = step
+        comm = getattr(self.kfac, "factor_comm", None)
+        if comm is not None and comm.defer:
+            # Deferred factor reduction: merge every comm_freq-th capture
+            # step, and ALWAYS before eigen reads the factors — both the
+            # monolithic refresh and chunk 0 of a pipelined pass (later
+            # chunks reuse the merged snapshot already in ``facs``).
+            flush = flags["update_eigen"] or (
+                flags["update_factors"]
+                and (step // hp.fac_update_freq) % comm.comm_freq == 0
+            )
+            if chunk == 0:
+                flush = True
+            flags["flush_factors"] = flush
         age = (
             0
             if self._last_refresh_step is None

@@ -58,6 +58,14 @@ def make_lm_train_step(
             "grad_comm_dtype compresses the data-parallel gradient mean and "
             "needs mesh= to know the reduction axis"
         )
+    # Factor-communication plane, same plumbing as training.step: active
+    # knobs force the explicit-collective wrapper (grads then pmean at f32
+    # when grad_comm_dtype is unset), defaulting the wrapper mesh to the
+    # plane's own.
+    factor_comm = kfac.factor_comm if kfac is not None else None
+    comm_active = factor_comm is not None and factor_comm.active
+    if comm_active and mesh is None:
+        mesh = kfac.mesh
 
     def _compute(params, tokens, targets, carry, dropout_rng, capture_stats):
         rngs = {"dropout": dropout_rng}
@@ -136,12 +144,17 @@ def make_lm_train_step(
             loss, grads, a_c, g_s, new_carry = _compute(
                 params, tokens, targets, carry, rng, capture_stats
             )
-            grads = pmean_compressed(grads, axis, grad_comm_dtype)
+            wire = grad_comm_dtype if grad_comm_dtype is not None else jnp.float32
+            grads = pmean_compressed(grads, axis, wire)
             loss = jax.lax.pmean(loss, axis)
             if a_c is not None:
-                a_c = jax.lax.pmean(a_c, axis)
-            if g_s is not None:
-                g_s = jax.lax.pmean(g_s, axis)
+                # bucketed/compressed/deferred factor exchange — the LM twin
+                # of training.step's routing through the comm plane
+                if factor_comm is not None:
+                    a_c, g_s = factor_comm.exchange_contribs(a_c, g_s, axis)
+                else:
+                    a_c = jax.lax.pmean(a_c, axis)
+                    g_s = jax.lax.pmean(g_s, axis)
             return loss, grads, a_c, g_s, new_carry
 
         return _inner(params, tokens, targets, carry, dropout_rng)
@@ -159,6 +172,7 @@ def make_lm_train_step(
         diag_warmup_done: bool = True,
         eigen_chunk=None,
         swap_eigen: bool = False,
+        flush_factors: bool = False,
     ):
         tokens, targets = batch  # [B, T] each
         carry = jax.lax.stop_gradient(carry)  # truncate BPTT at segment edge
@@ -166,7 +180,9 @@ def make_lm_train_step(
 
         compute = (
             _compute_compressed
-            if grad_comm_dtype is not None and mesh.devices.size > 1
+            if (grad_comm_dtype is not None or comm_active)
+            and mesh is not None
+            and mesh.devices.size > 1
             else _compute
         )
         loss, grads, a_c, g_s, new_carry = compute(
@@ -190,6 +206,7 @@ def make_lm_train_step(
                 diag_warmup_done=diag_warmup_done,
                 eigen_chunk=eigen_chunk,
                 swap_eigen=swap_eigen,
+                flush_factors=flush_factors,
             )
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -216,6 +233,7 @@ def make_lm_train_step(
             "diag_warmup_done",
             "eigen_chunk",
             "swap_eigen",
+            "flush_factors",
         ),
         donate_argnames=("state",),
     )

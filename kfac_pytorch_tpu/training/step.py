@@ -56,7 +56,7 @@ def pmean_compressed(tree: PyTree, axis: str, comm_dtype) -> PyTree:
     )
 
 
-def _compressed_grads(compute, mesh, comm_dtype, accum_steps):
+def _compressed_grads(compute, mesh, comm_dtype, accum_steps, factor_comm=None):
     """Wrap a loss-and-grads computation so the DP gradient mean crosses the
     wire in ``comm_dtype`` — the reference's ``--fp16-allreduce`` Horovod
     compression (pytorch_cifar10_resnet.py:190-195), TPU-native.
@@ -66,10 +66,16 @@ def _compressed_grads(compute, mesh, comm_dtype, accum_steps):
     wrapper makes the reduction explicit: a ``shard_map`` over the (single)
     mesh axis computes per-device grads from the LOCAL microbatch, casts
     them to ``comm_dtype``, and one ``pmean`` reassembles — only the
-    downcast values travel. Loss/accuracy and any K-FAC factor statistics
-    pmean alongside in f32 (the reference never compresses its factor
-    allreduce either — only ``DistributedOptimizer``'s grad one). Exact up
-    to the downcast rounding of each device's partial gradient.
+    downcast values travel. Exact up to the downcast rounding of each
+    device's partial gradient.
+
+    K-FAC factor statistics exchange alongside through ``factor_comm`` (the
+    preconditioner's ``FactorComm`` plane, parallel/comm.py): all per-layer
+    A/G leaves fuse into a few flat buckets — one collective per bucket
+    instead of two per layer — optionally downcast for the wire, or (in
+    deferred mode) not reduced here at all; at f32/freq-1 defaults the
+    bucketed mean is bitwise what the old per-layer pmeans produced. With
+    ``factor_comm=None`` (no preconditioner) there are no statistics.
 
     Semantics note, same as the reference: BatchNorm inside the wrapper
     normalizes over the LOCAL per-device batch (each Horovod rank's torch BN
@@ -99,9 +105,13 @@ def _compressed_grads(compute, mesh, comm_dtype, accum_steps):
         if new_bs:
             new_bs = lax.pmean(new_bs, axis)
         if a_c is not None:
-            a_c = lax.pmean(a_c, axis)
-        if g_s is not None:
-            g_s = lax.pmean(g_s, axis)
+            if factor_comm is not None:
+                a_c, g_s = factor_comm.exchange_contribs(a_c, g_s, axis)
+            else:
+                # standalone use without a preconditioner plane: keep the
+                # per-leaf f32 exchange
+                a_c = lax.pmean(a_c, axis)
+                g_s = lax.pmean(g_s, axis)
         return loss, acc, grads, new_bs, a_c, g_s
 
     return _inner
@@ -249,6 +259,15 @@ def make_train_step(
             "needs mesh= to know the reduction axis — refusing a config "
             "whose numerics would silently change when run at scale"
         )
+    # Factor-communication plane (parallel/comm.py). When its knobs are
+    # non-default the factor exchange must be an EXPLICIT collective, so the
+    # step routes through the shard_map wrapper even without grad_comm_dtype
+    # (grads then pmean at f32); the plane was validated against kfac.mesh,
+    # which becomes the wrapper mesh unless the caller passed one.
+    factor_comm = kfac.factor_comm if kfac is not None else None
+    comm_active = factor_comm is not None and factor_comm.active
+    if comm_active and mesh is None:
+        mesh = kfac.mesh
 
     def loss_and_grads_captured(params, batch_stats, images, labels):
         # Trace-time scope: the KFACConv layers inside model.apply route
@@ -407,6 +426,7 @@ def make_train_step(
         diag_warmup_done: bool = True,
         eigen_chunk=None,
         swap_eigen: bool = False,
+        flush_factors: bool = False,
     ):
         images, labels = batch
         capture_stats = kfac is not None and update_factors
@@ -426,9 +446,18 @@ def make_train_step(
                 )
             return loss_and_grads_plain(params, batch_stats, images, labels)
 
-        if grad_comm_dtype is not None and mesh is not None and mesh.devices.size > 1:
+        use_wrapper = (
+            (grad_comm_dtype is not None or comm_active)
+            and mesh is not None
+            and mesh.devices.size > 1
+        )
+        if use_wrapper:
             loss, acc, grads, new_bs, a_c, g_s = _compressed_grads(
-                _compute, mesh, grad_comm_dtype, accum_steps
+                _compute,
+                mesh,
+                grad_comm_dtype if grad_comm_dtype is not None else jnp.float32,
+                accum_steps,
+                factor_comm,
             )(state.params, state.batch_stats, images, labels)
         else:
             loss, acc, grads, new_bs, a_c, g_s = _compute(
@@ -454,6 +483,7 @@ def make_train_step(
                 diag_warmup_done=diag_warmup_done,
                 eigen_chunk=eigen_chunk,
                 swap_eigen=swap_eigen,
+                flush_factors=flush_factors,
             )
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -480,6 +510,7 @@ def make_train_step(
             "diag_warmup_done",
             "eigen_chunk",
             "swap_eigen",
+            "flush_factors",
         ),
         donate_argnames=("state",),
     )
@@ -547,8 +578,20 @@ def kfac_flags_for_step(
     if kfac is None:
         return {"update_factors": False, "update_eigen": False}
     hp = kfac.hparams
-    return {
+    flags = {
         "update_factors": step % hp.fac_update_freq == 0,
         "update_eigen": step % hp.kfac_update_freq == 0,
         "diag_warmup_done": epoch is None or epoch >= kfac.diag_warmup,
     }
+    comm = getattr(kfac, "factor_comm", None)
+    if comm is not None and comm.defer:
+        # Deferred factor communication: merge the per-replica running
+        # averages every comm_freq-th CAPTURE step, and always on an eigen
+        # refresh (which must never read unmerged local factors). Key only
+        # present in deferred mode, so other configs' flag dicts (and
+        # compiled-variant sets) are untouched.
+        flags["flush_factors"] = flags["update_eigen"] or (
+            flags["update_factors"]
+            and (step // hp.fac_update_freq) % comm.comm_freq == 0
+        )
+    return flags

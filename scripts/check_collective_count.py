@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Pin the factor-communication fusion in compiled HLO.
+
+The FactorComm plane (parallel/comm.py) replaces the per-layer factor
+pmeans — two collectives per K-FAC layer per capture step — with one
+collective per flat bucket. This check compiles a mixed conv/dense train
+step on the 8-device CPU mesh with the plane active and counts the
+``all-reduce`` ops the capture variant adds over the plain variant: that
+delta is the factor path's wire cost, and it must stay ≤ the plane's bucket
+count. If a change reintroduces per-leaf reductions (or XLA stops fusing
+the bucketed ones), the delta jumps to ~2× the layer count and this fails.
+
+Exit 0 with an "OK" line, 1 with a report. Run from the repo root
+(tier-1 wraps it in a test, tests/test_scripts.py).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kfac_pytorch_tpu import platform_override  # noqa: E402
+
+if not platform_override.force_cpu_devices(8):
+    print("check_collective_count: SKIP — could not force 8 CPU devices "
+          "(backend already initialized)", file=sys.stderr)
+    sys.exit(1)
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kfac_pytorch_tpu import KFAC  # noqa: E402
+from kfac_pytorch_tpu.models.layers import KFACConv, KFACDense  # noqa: E402
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh  # noqa: E402
+from kfac_pytorch_tpu.training.step import (  # noqa: E402
+    TrainState,
+    make_sgd,
+    make_train_step,
+)
+
+# matches the op name at an instruction site: "all-reduce(" and
+# "all-reduce-start(" (async), but not "all-reduce-done("
+_ALLREDUCE_RE = re.compile(r"all-reduce(?:-start)?\(")
+
+
+class _Net(nn.Module):
+    """Conv + dense mix: several A/G leaves of different shapes, so the
+    bucket planner has real fusion work."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(KFACConv(8, (3, 3), name="conv1")(x))
+        x = nn.relu(KFACConv(8, (3, 3), name="conv2")(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(KFACDense(16, name="fc1")(x))
+        return KFACDense(10, name="fc2")(x)
+
+
+def _count_allreduce(hlo: str) -> int:
+    return len(_ALLREDUCE_RE.findall(hlo))
+
+
+def main() -> int:
+    mesh = data_parallel_mesh()
+    model = _Net()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 8, 8, 3).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=16))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = make_sgd(momentum=0.9)
+    params = variables["params"]
+    # bf16 wire activates the plane (and the explicit-collective wrapper)
+    # at comm_freq=1, so the capture variant carries the bucketed exchange
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                mesh=mesh, factor_comm_dtype="bf16")
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    step_fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    lr, damping = jnp.float32(0.1), jnp.float32(0.01)
+
+    def hlo(**flags):
+        lowered = step_fn.lower(state, (x, y), lr, damping, **flags)
+        return lowered.compile().as_text()
+
+    plain = _count_allreduce(hlo(update_factors=False, update_eigen=False))
+    captured = _count_allreduce(hlo(update_factors=True, update_eigen=False))
+    buckets = kfac.factor_comm.last_collectives
+    if buckets is None:
+        print("check_collective_count: FAIL — the capture trace never "
+              "planned factor buckets (plane inactive?)", file=sys.stderr)
+        return 1
+
+    delta = captured - plain
+    print(
+        f"check_collective_count: plain step {plain} all-reduce(s), capture "
+        f"step {captured}; factor-path delta {delta} vs {buckets} planned "
+        f"bucket(s) [{kfac.factor_comm.last_wire_bytes} wire bytes]"
+    )
+    if delta > buckets:
+        print(
+            f"check_collective_count: FAIL — the capture variant adds "
+            f"{delta} all-reduces but the plane planned only {buckets} "
+            "bucket(s); the factor exchange has unfused into per-leaf "
+            "collectives", file=sys.stderr,
+        )
+        return 1
+    print(f"check_collective_count: OK — factor exchange fused into "
+          f"≤ {buckets} bucketed all-reduce(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

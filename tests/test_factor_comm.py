@@ -1,0 +1,346 @@
+"""Factor-communication plane (parallel/comm.py) on the 8-device CPU mesh.
+
+Pins the three wire levers and their escape hatches: (a) bucketed fusion —
+the f32 bucketed pmean is BITWISE what the per-layer pmeans it replaced
+produce (``per_layer_pmean_reference`` is the oracle) and the flat-buffer
+round-trip is exact across conv/dense/embed shape mixes; (b) bf16 wire
+compression — step-level parity within downcast tolerance, wire bytes
+halved; (c) deferred reduction — per-replica local EMAs merged every N
+capture steps equal the per-step-reduced run (EMA linearity), params
+bitwise-tracking between refreshes, and every refresh forces a flush
+(``kfac_flags_for_step`` / ``EigenRefreshCadence`` cadence + ``KFAC.update``
+validation).
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC, compat
+from kfac_pytorch_tpu.compile_cache import expected_step_variants
+from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.parallel.assignment import plan_factor_buckets
+from kfac_pytorch_tpu.parallel.comm import (
+    FactorComm,
+    flatten_buckets,
+    per_layer_pmean_reference,
+    unflatten_buckets,
+)
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.scheduler import EigenRefreshCadence
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    kfac_flags_for_step,
+    make_sgd,
+    make_train_step,
+)
+
+
+# ---------------------------------------------------------------- planning
+
+
+def test_plan_greedy_packing():
+    """First-fit in leaf order: close the bucket when the next leaf would
+    exceed the cap; never reorder (layout must be deterministic)."""
+    plan = plan_factor_buckets([(4, 4), (4, 4), (3,)], max_bucket_elems=20)
+    assert [b.size for b in plan] == [16, 19]
+    assert [e.index for b in plan for e in b.entries] == [0, 1, 2]
+    assert plan[1].entries[0].offset == 0
+    assert plan[1].entries[1].offset == 16
+    assert plan[1].entries[1].shape == (3,)
+
+
+def test_plan_oversized_leaf_own_bucket():
+    plan = plan_factor_buckets([(2, 2), (50,), (2, 2)], max_bucket_elems=8)
+    assert [b.size for b in plan] == [4, 50, 4]
+
+
+def test_plan_rejects_bad_cap():
+    with pytest.raises(ValueError):
+        plan_factor_buckets([(2, 2)], max_bucket_elems=0)
+
+
+def test_flatten_round_trip_mixed_shapes():
+    """Conv patch-covariance, dense (bias/no-bias), embed diagonal-A and
+    grouped-conv stacked leaves all survive the flat-buffer round trip."""
+    r = np.random.RandomState(0)
+    shapes = [
+        (75, 75),   # conv A (3*3*8 + bias)
+        (16, 16),   # conv G
+        (33, 33),   # dense A with bias
+        (10, 10),   # dense G
+        (512,),     # embed diagonal A
+        (4, 9, 9),  # grouped conv: stacked [G, a, a]
+        (1, 1),     # degenerate
+    ]
+    leaves = [jnp.asarray(r.randn(*s).astype(np.float32)) for s in shapes]
+    for cap in (1, 64, 1 << 20):
+        plan = plan_factor_buckets(shapes, max_bucket_elems=cap)
+        bufs = flatten_buckets(leaves, plan)
+        assert sum(b.size for b in plan) == sum(int(np.prod(s)) for s in shapes)
+        back = unflatten_buckets(bufs, plan, leaves)
+        for a, b in zip(leaves, back):
+            assert a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------ wire parity
+
+
+def test_bucketed_f32_pmean_bitwise_matches_per_layer():
+    """The fused f32 exchange is a pure restructure: bitwise-identical to
+    one pmean per stat leaf (mean of the same values, same dtype — the
+    concat/slice around the collective moves no float)."""
+    mesh = data_parallel_mesh()
+    fc = FactorComm(mesh=mesh, comm_dtype=jnp.float32, comm_freq=1)
+    r = np.random.RandomState(1)
+    n = mesh.devices.size
+    vals = {
+        name: jnp.asarray(r.randn(n, *s).astype(np.float32))
+        for name, s in [("l1", (6, 6)), ("l2", (17,)), ("l3", (3, 4, 2))]
+    }
+
+    def _shard_mapped(fn):
+        @partial(
+            compat.shard_map, mesh=mesh,
+            in_specs=(P("data"),), out_specs=P(), check_vma=False,
+        )
+        def run(tree):
+            local = jax.tree_util.tree_map(lambda x: x[0], tree)
+            return fn(local)
+        return run
+
+    out_bucketed = _shard_mapped(lambda t: fc.allreduce(t, "data"))(vals)
+    out_ref = _shard_mapped(
+        lambda t: per_layer_pmean_reference(t, "data")
+    )(vals)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_bucketed),
+        jax.tree_util.tree_leaves(out_ref),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert fc.last_collectives is not None and fc.last_collectives >= 1
+
+
+def test_exchange_contribs_defer_is_noop():
+    mesh = data_parallel_mesh()
+    fc = FactorComm(mesh=mesh, comm_freq=4)
+    a = {"l1": jnp.ones((3, 3))}
+    g = {"l1": jnp.ones((2, 2))}
+    a2, g2 = fc.exchange_contribs(a, g, "data")
+    assert a2 is a and g2 is g  # statistics stay local until flush
+
+
+def test_flush_requires_defer():
+    fc = FactorComm(mesh=None, comm_freq=1)
+    with pytest.raises(ValueError, match="defer"):
+        fc.flush({"l1": {"A": jnp.ones((2, 2)), "G": jnp.ones((2, 2))}})
+
+
+# --------------------------------------------------------------- e2e step
+
+
+class _MLP(nn.Module):
+    """BN-free toy (same as test_grad_comm): isolates factor-wire effects
+    from BatchNorm's documented local-batch semantics change."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(KFACDense(32, name="fc1")(x))
+        return KFACDense(10, name="fc2")(x)
+
+
+def _setup(model, kfac, mesh=None, grad_comm_dtype=None, batch=16, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(batch, 4, 6).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=batch))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    params = variables["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params) if kfac else None,
+    )
+    step_fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True},
+        mesh=mesh, grad_comm_dtype=grad_comm_dtype,
+    )
+    return state, step_fn, (x, y)
+
+
+def _put(state, batch, mesh):
+    shard = NamedSharding(mesh, P("data"))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    return state, tuple(jax.device_put(b, shard) for b in batch)
+
+
+def _assert_close(pa, pb, rtol, atol):
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pb)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
+def test_bf16_factor_compression_close_and_halves_wire():
+    """Active plane (bf16 wire): the step auto-routes through the explicit-
+    collective wrapper off kfac.mesh, params track the GSPMD reference to
+    downcast tolerance, and the planned wire bytes are half of f32."""
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    k_ref = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+    k_bf16 = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                  mesh=mesh, factor_comm_dtype="bf16")
+    assert k_bf16.factor_comm.active and not k_ref.factor_comm.active
+    s_ref, f_ref, batch = _setup(model, k_ref)
+    s_cmp, f_cmp, _ = _setup(model, k_bf16)  # no mesh arg: defaults to kfac's
+
+    for kfac, (state, fn) in ((k_ref, (s_ref, f_ref)),
+                              (k_bf16, (s_cmp, f_cmp))):
+        state, b = _put(state, batch, mesh)
+        for i in range(3):
+            state, m = fn(state, b, jnp.float32(0.05), jnp.float32(0.01),
+                          update_factors=True, update_eigen=i == 0)
+        if kfac is k_ref:
+            p_ref = jax.device_get(state.params)
+        else:
+            p_cmp = jax.device_get(state.params)
+    _assert_close(p_cmp, p_ref, rtol=3e-2, atol=3e-3)
+
+    fc = k_bf16.factor_comm
+    assert fc.last_collectives is not None
+    total_elems = sum(
+        b.size for plan in fc._plans.values() for b in plan
+    ) // max(len(fc._plans), 1)
+    # one cached plan; bf16 wire = 2 bytes/elem, half the f32 4 bytes/elem
+    assert len(fc._plans) == 1
+    assert fc.last_wire_bytes == total_elems * 2
+
+
+def test_deferred_matches_per_step_reduction():
+    """comm_freq=3 on frozen data: params bitwise-track the per-step run
+    between refreshes (factors feed only the eigendecomposition), the
+    flush-step factors equal the per-step-reduced EMAs (linearity), and
+    factor_sync_age resets on flush."""
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    k_ps = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=10)
+    k_def = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=10,
+                 mesh=mesh, factor_comm_freq=3)
+    assert k_def.factor_comm.defer
+
+    # both runs use the f32 explicit-collective wrapper so the gradient
+    # path is identical bitwise; only the factor exchange policy differs
+    s_ps, f_ps, batch = _setup(model, k_ps, mesh=mesh,
+                               grad_comm_dtype=jnp.float32)
+    s_def, f_def, _ = _setup(model, k_def, mesh=mesh,
+                             grad_comm_dtype=jnp.float32)
+
+    s_ps, b = _put(s_ps, batch, mesh)
+    s_def, _ = _put(s_def, batch, mesh)
+    ages = []
+    for step in range(6):
+        fl_ps = kfac_flags_for_step(step, k_ps)
+        fl_def = kfac_flags_for_step(step, k_def)
+        assert "flush_factors" not in fl_ps  # key only exists when deferred
+        s_ps, _ = f_ps(s_ps, b, jnp.float32(0.05), jnp.float32(0.01), **fl_ps)
+        s_def, _ = f_def(s_def, b, jnp.float32(0.05), jnp.float32(0.01),
+                         **fl_def)
+        ages.append(int(jax.device_get(s_def.kfac_state["factor_sync_age"])))
+        # params only read the eigenbasis (refreshed at step 0, where both
+        # runs are synced), so the deferred run tracks bitwise-tight
+        _assert_close(jax.device_get(s_def.params),
+                      jax.device_get(s_ps.params), rtol=1e-6, atol=1e-7)
+        if fl_def.get("flush_factors"):
+            # merged local EMAs == per-step-reduced EMA (linearity of the
+            # running average; reassociation only)
+            _assert_close(jax.device_get(s_def.kfac_state["factors"]),
+                          jax.device_get(s_ps.kfac_state["factors"]),
+                          rtol=1e-5, atol=1e-6)
+    # flushes at capture steps 0 and 3; age counts capture steps since
+    assert ages == [0, 1, 2, 0, 1, 2]
+
+
+# ---------------------------------------------------------------- cadence
+
+
+def _mesh_kfac(**kw):
+    return KFAC(damping=0.01, mesh=data_parallel_mesh(), **kw)
+
+
+def test_flags_flush_cadence():
+    kfac = _mesh_kfac(fac_update_freq=2, kfac_update_freq=12,
+                      factor_comm_freq=3)
+    flush_steps = [
+        s for s in range(13)
+        if kfac_flags_for_step(s, kfac).get("flush_factors")
+    ]
+    # capture steps are 0,2,4,...; every 3rd capture (steps 0, 6) plus the
+    # eigen refresh (step 12, also a capture multiple-of-3)
+    assert flush_steps == [0, 6, 12]
+    assert kfac_flags_for_step(12, kfac)["update_eigen"]
+
+
+def test_cadence_chunk0_forces_flush():
+    """Pipelined refresh: chunk 0 must read merged factors even when the
+    capture cadence wouldn't flush that step; later chunks must not."""
+    kfac = _mesh_kfac(fac_update_freq=4, kfac_update_freq=4, eigh_chunks=2,
+                      factor_comm_freq=100)
+    cad = EigenRefreshCadence(kfac)
+    f0 = cad.flags_for_step(0)
+    assert f0["update_eigen"] and f0["flush_factors"]  # monolithic bootstrap
+    for s in range(1, 4):
+        assert not cad.flags_for_step(s)["flush_factors"]
+    f4 = cad.flags_for_step(4)
+    assert f4["eigen_chunk"] == (0, 2) and f4["flush_factors"]
+    f5 = cad.flags_for_step(5)
+    assert f5["eigen_chunk"] == (1, 2) and f5["swap_eigen"]
+    assert not f5["flush_factors"]
+
+
+def test_update_validates_flush():
+    model = _MLP()
+    x = jnp.zeros((8, 4, 6), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    k_plain = KFAC(damping=0.01)
+    st = k_plain.init(params)
+    with pytest.raises(ValueError, match="flush_factors"):
+        k_plain.update(grads, st, lr=jnp.float32(0.1),
+                       update_factors=False, update_eigen=False,
+                       flush_factors=True)
+
+    k_def = _mesh_kfac(factor_comm_freq=2)
+    st = k_def.init(params)
+    with pytest.raises(ValueError, match="flush_factors"):
+        k_def.update(grads, st, lr=jnp.float32(0.1),
+                     update_factors=True, update_eigen=True,
+                     flush_factors=False)
+    k_chunked = _mesh_kfac(factor_comm_freq=2, eigh_chunks=2,
+                           kfac_update_freq=4)
+    st = k_chunked.init(params)
+    with pytest.raises(ValueError, match="flush_factors"):
+        k_chunked.update(grads, st, lr=jnp.float32(0.1),
+                         update_factors=True, update_eigen=False,
+                         eigen_chunk=(0, 2), flush_factors=False)
+
+
+def test_expected_step_variants_deferred():
+    assert expected_step_variants(KFAC(damping=0.01)) == 3
+    assert expected_step_variants(_mesh_kfac(factor_comm_freq=2)) == 4
+    assert expected_step_variants(
+        KFAC(damping=0.01, eigh_chunks=3, kfac_update_freq=6)
+    ) == 9
+    assert expected_step_variants(
+        _mesh_kfac(eigh_chunks=3, kfac_update_freq=6, factor_comm_freq=2)
+    ) == 11

@@ -72,6 +72,52 @@ def test_pallas_interpret_lint_clean():
     assert "OK" in res.stdout
 
 
+def test_collective_count_check():
+    """The compiled capture step must carry ≤ bucket-count factor
+    all-reduces over the plain step — per-leaf collectives sneaking back in
+    means the FactorComm fusion regressed
+    (scripts/check_collective_count.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_collective_count.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
+def test_bench_cpu_fallback_emits_json():
+    """bench.py must emit parseable, schema-complete JSON with rc=0 even
+    when the TPU backend never comes up: the probe subprocess (stubbed here
+    with a sleeper) times out per attempt, the retry budget is wall-clock,
+    and exhaustion falls back to the CPU backend instead of hanging to
+    rc=124 (the BENCH_r03 failure mode)."""
+    import json
+
+    env = dict(os.environ)
+    env.pop("KFAC_FORCE_PLATFORM", None)  # forcing a platform skips the probe
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KFAC_BENCH_PROBE_CMD=(
+            f'{sys.executable} -c "import time; time.sleep(30)"'
+        ),
+        KFAC_BENCH_PROBE_TIMEOUT_S="1",
+        KFAC_BENCH_RETRY_S="2",
+        KFAC_BENCH_ARMS="none",  # no arm keys match: skip all measurements
+        KFAC_BENCH_SKIP_TRANSFORMER="1",
+        KFAC_BENCH_WALL_S="120",
+    )
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=110, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, f"rc={res.returncode}\n{res.stderr[-2000:]}"
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout lines\n{res.stderr[-2000:]}"
+    rec = json.loads(lines[-1])
+    assert rec["metric"] and "value" in rec and "vs_baseline" in rec
+    assert rec["detail"]["backend_fallback"] == "cpu"
+
+
 def test_summarize_curves_compare_fallback(tmp_path):
     """--compare falls back to a shared lower-is-better tag when the runs
     have no val/accuracy (LM logs), and counts wins with <= semantics."""
