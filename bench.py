@@ -565,6 +565,26 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         },
     )
 
+    # Refresh-phase latency percentiles + resident eigen-table footprint:
+    # the low-rank solver's two headline levers (matmul-only refresh,
+    # rectangular [n,r] Q tables) — recorded for EVERY arm so the -rsvd arm
+    # reads directly against the f32 baseline's dense eigh / square tables.
+    eigen_table_bytes = sum(
+        leaf.nbytes
+        for key in ("eigen", "eigen_stacked")
+        for leaf in jax.tree_util.tree_leaves(s_kfac.kfac_state.get(key, {}))
+    )
+    rec.update(
+        solver=getattr(kfac, "solver", "eigh"),
+        solver_rank=(
+            kfac.solver_rank if getattr(kfac, "solver", "eigh") == "rsvd"
+            else None
+        ),
+        eigen_table_bytes=int(eigen_table_bytes),
+        refresh_ms_p50=round(float(np.percentile(win_full, 50)) * 1e3, 3),
+        refresh_ms_p95=round(float(np.percentile(win_full, 95)) * 1e3, 3),
+    )
+
     chunks = int(kfac_kwargs.get("eigh_chunks", 1) or 1)
     if chunks > 1:
         # Pipelined-refresh arm: one timing per chunk-step program. Offsets
@@ -860,6 +880,12 @@ def main():
         # factor wire bytes/collectives from the plane's trace-time gauges
         ("factor_comm", "-comm", batch, None,
          dict(factor_comm_dtype="bf16", factor_comm_freq=fac_freq), True),
+        # -rsvd: the randomized low-rank curvature solver — compare its
+        # refresh_ms_p50/p95 and eigen_table_bytes against the f32 arm's
+        # (dense eigh, square Q tables) at identical numerics elsewhere
+        ("rsvd", "-rsvd", batch, None,
+         dict(solver="rsvd", solver_rank=128, solver_auto_threshold=512),
+         True),
         ("aggressive", "-aggr", batch, None,
          dict(precond_precision=lax.Precision.DEFAULT,
               eigen_dtype=jnp.bfloat16), True),

@@ -196,6 +196,18 @@ def parse_args(argv=None):
                    help="log per-epoch K-FAC stability telemetry (KL-clip "
                         "coefficient nu min/mean, min damped eigenvalue) to "
                         "--log-dir")
+    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+                   help="curvature eigensolver: eigh = full (dense) "
+                        "eigendecomposition, rsvd = randomized truncated "
+                        "eigensolve + low-rank Woodbury apply for factor "
+                        "sides >= --solver-auto-threshold (docs/PERF.md)")
+    p.add_argument("--solver-rank", type=int, default=128,
+                   help="eigenpairs kept per truncated factor side "
+                        "(--solver rsvd); watch kfac/spectrum_mass_captured "
+                        "to size it")
+    p.add_argument("--solver-auto-threshold", type=int, default=512,
+                   help="factor sides at least this large use the truncated "
+                        "solver; smaller sides stay dense (--solver rsvd)")
     p.add_argument("--bn-recal-batches", type=int, default=0,
                    help="refresh BatchNorm running statistics with this many "
                         "clean train-mode forwards before each eval (0 = "
@@ -278,6 +290,9 @@ def main(argv=None):
             factor_kernel=args.factor_kernel,
             factor_comm_dtype=args.factor_comm_dtype,
             factor_comm_freq=args.factor_comm_freq,
+            solver=args.solver,
+            solver_rank=args.solver_rank,
+            solver_auto_threshold=args.solver_auto_threshold,
         )
         kfac_sched = KFACParamScheduler(
             kfac,
@@ -463,6 +478,11 @@ def main(argv=None):
                 nu_min, nu_sum, nu_n = min(nu_min, nu), nu_sum + nu, nu_n + 1
                 e = float(m["kfac_min_damped_eig"])
                 eig_min = e if eig_min is None else min(eig_min, e)
+            if "kfac_spectrum_mass" in m:
+                tel.set_gauge(
+                    "kfac/spectrum_mass_captured",
+                    float(m["kfac_spectrum_mass"]),
+                )
             for k in DIAG_EXTRA_KEYS:
                 if k in m:
                     s, c = diag_acc.get(k, (0.0, 0))

@@ -117,6 +117,18 @@ def parse_args(argv=None):
                    help="allreduce factor statistics every N capture steps "
                         "(merged running averages, always flushed before an "
                         "eigen refresh); pure-DP only; 1 = per-step, exact")
+    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+                   help="curvature eigensolver: eigh = full (dense) "
+                        "eigendecomposition, rsvd = randomized truncated "
+                        "eigensolve + low-rank Woodbury apply for factor "
+                        "sides >= --solver-auto-threshold (docs/PERF.md)")
+    p.add_argument("--solver-rank", type=int, default=128,
+                   help="eigenpairs kept per truncated factor side "
+                        "(--solver rsvd); watch kfac/spectrum_mass_captured "
+                        "to size it")
+    p.add_argument("--solver-auto-threshold", type=int, default=512,
+                   help="factor sides at least this large use the truncated "
+                        "solver; smaller sides stay dense (--solver rsvd)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--telemetry-dir", default=None,
@@ -207,6 +219,9 @@ def main(argv=None):
             eigh_chunks=args.eigh_chunks,
             factor_comm_dtype=args.factor_comm_dtype,
             factor_comm_freq=args.factor_comm_freq,
+            solver=args.solver,
+            solver_rank=args.solver_rank,
+            solver_auto_threshold=args.solver_auto_threshold,
         )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
@@ -296,6 +311,11 @@ def main(argv=None):
 
         def eat(m):
             loss_m.update(m["loss"])
+            if "kfac_spectrum_mass" in m:
+                tel.set_gauge(
+                    "kfac/spectrum_mass_captured",
+                    float(m["kfac_spectrum_mass"]),
+                )
             for k, v in m.items():
                 if k.startswith("kfac_"):
                     s, c = diag_acc.get(k, (0.0, 0))

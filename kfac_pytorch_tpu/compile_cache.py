@@ -66,6 +66,11 @@ def expected_step_variants(kfac) -> int:
     monolithic schedule adds one program (factors-without-flush; the eigen
     step always flushes), the pipelined schedule two (the factors-only and
     chunk-0 programs each gain a flush twin).
+
+    The curvature solver choice (``solver="rsvd"`` vs ``"eigh"``) does NOT
+    change the count: the rank policy is a pure function of static factor
+    shapes, so it swaps WHICH programs compile (truncated vs dense refresh,
+    Woodbury vs dense apply), never how many the schedule produces.
     """
     if kfac is None:
         return 1

@@ -33,10 +33,18 @@ from kfac_pytorch_tpu.ops.eigh import (
     blocked_eigh,
     eigh_with_floor,
     get_block_boundary,
+    symmetrize,
 )
 from kfac_pytorch_tpu.ops.precondition import (
     kl_clip_coefficient,
     precondition_mat,
+    precondition_mat_lowrank,
+    solve_eigen_entry,
+)
+from kfac_pytorch_tpu.ops.rsvd import (
+    batched_randomized_eigh,
+    bucketed_rsvd_eigh,
+    residual_rho,
 )
 
 __all__ = [
@@ -61,6 +69,12 @@ __all__ = [
     "blocked_eigh",
     "eigh_with_floor",
     "get_block_boundary",
+    "symmetrize",
     "kl_clip_coefficient",
     "precondition_mat",
+    "precondition_mat_lowrank",
+    "solve_eigen_entry",
+    "batched_randomized_eigh",
+    "bucketed_rsvd_eigh",
+    "residual_rho",
 ]

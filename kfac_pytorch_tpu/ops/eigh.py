@@ -13,6 +13,14 @@ from typing import Dict, List, Sequence, Tuple
 import jax.numpy as jnp
 
 
+def symmetrize(factor: jnp.ndarray) -> jnp.ndarray:
+    """``0.5 * (X + Xᵀ)`` — the shared conditioning pre-step of EVERY solver
+    entry point (dense eigh, bucketed eigh, randomized rsvd): running-average
+    factors accumulate tiny asymmetries in f32, and the solvers assume exact
+    symmetry. One implementation so the paths cannot drift apart."""
+    return 0.5 * (factor + jnp.swapaxes(factor, -1, -2))
+
+
 def eigh_with_floor(
     factor: jnp.ndarray, eps: float = 1e-10
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -20,11 +28,10 @@ def eigh_with_floor(
 
     Returns ``(Q, d)`` with ``factor ≈ Q diag(d) Qᵀ``; eigenvalues ``<= eps``
     are zeroed exactly as the reference does (``d * (d > eps)``,
-    kfac_preconditioner.py:252-253). The input is explicitly symmetrized —
-    running-average factors accumulate tiny asymmetries in f32.
+    kfac_preconditioner.py:252-253). The input is explicitly symmetrized
+    (:func:`symmetrize`).
     """
-    sym = 0.5 * (factor + factor.T)
-    d, q = jnp.linalg.eigh(sym)
+    d, q = jnp.linalg.eigh(symmetrize(factor))
     d = d * (d > eps).astype(d.dtype)
     return q, d
 
@@ -140,10 +147,7 @@ def bucketed_eigh(
     results: List[Tuple[jnp.ndarray, jnp.ndarray]] = [None] * len(blocks)  # type: ignore
     for m, idxs in sorted(order.items()):
         stack = jnp.stack(
-            [
-                pad_for_eigh(0.5 * (blocks[i] + blocks[i].T), m)
-                for i in idxs
-            ]
+            [pad_for_eigh(symmetrize(blocks[i]), m) for i in idxs]
         )
         q, d = batched_eigh(stack)
         for row, i in enumerate(idxs):

@@ -154,6 +154,191 @@ def precondition_mat_embed(
     return jnp.matmul(q_g, v2, precision=precision)
 
 
+# ---------------------------------------------------------------------------
+# Low-rank-plus-diagonal (Woodbury) apply path — solver="rsvd"
+#
+# A side the randomized solver truncated stores (Q_r [n, r], d_r [r], rho)
+# modelling the factor as  F ≈ Q_r diag(d_r) Q_rᵀ + rho·(I − Q_r Q_rᵀ).
+# Because Q_r's columns are orthonormal, (G ⊗ A + λI)⁻¹ splits EXACTLY over
+# the four sectors (captured/complement on each side): project the gradient
+# onto each sector, divide by that sector's damped eigenvalue product
+# (complement sides contribute the scalar rho), and re-expand. Every
+# operation is a thin [n, r] matmul or elementwise work — per-step cost drops
+# from O(n²) to O(n·r) per truncated side, and the eigen state the sharded
+# refresh broadcasts shrinks by the same factor. Low-rank entries reuse the
+# dense state keys (QA/dA/QG/dG) at rectangular shapes plus a scalar
+# ``rhoA``/``rhoG``; key presence is the dispatch signal
+# (:func:`solve_eigen_entry`).
+# ---------------------------------------------------------------------------
+
+
+def precondition_mat_lowrank(
+    grad_mat: jnp.ndarray,
+    q_a: jnp.ndarray,
+    q_g: jnp.ndarray,
+    d_a: jnp.ndarray,
+    d_g: jnp.ndarray,
+    rho_a: jnp.ndarray,
+    rho_g: jnp.ndarray,
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Woodbury solve with BOTH sides truncated: ``q_a [in, rA]``, ``q_g
+    [out, rG]``, eigenvalues ``d_a [rA]``/``d_g [rG]``, residual masses
+    ``rho_a``/``rho_g`` (scalars).
+
+    Sector decomposition of ``(G ⊗ A + λI)⁻¹``: captured×captured divides by
+    ``d_g d_aᵀ + λ``, captured×complement by ``d_g·rho_a + λ`` (and its
+    mirror), complement×complement by ``rho_g·rho_a + λ``. The identity-minus-
+    projector complements never materialize: the full-gradient term carries
+    the complement×complement inverse and the thin projections subtract the
+    double-counted sectors.
+    """
+    lam = damping
+    t1 = jnp.matmul(q_g.T, grad_mat, precision=precision)  # [rG, in]
+    t2 = jnp.matmul(grad_mat, q_a, precision=precision)  # [out, rA]
+    t3 = jnp.matmul(t1, q_a, precision=precision)  # [rG, rA]
+    c4 = 1.0 / (rho_g * rho_a + lam)
+    d2 = 1.0 / (d_g * rho_a + lam)  # [rG]
+    d3 = 1.0 / (rho_g * d_a + lam)  # [rA]
+    z = (
+        t3 / (d_g[:, None] * d_a[None, :] + lam)
+        - d2[:, None] * t3
+        - t3 * d3[None, :]
+        + c4 * t3
+    )
+    x = (d2 - c4)[:, None] * t1 + jnp.matmul(z, q_a.T, precision=precision)
+    y = t2 * (d3 - c4)[None, :]
+    return (
+        c4 * grad_mat
+        + jnp.matmul(q_g, x, precision=precision)
+        + jnp.matmul(y, q_a.T, precision=precision)
+    )
+
+
+def precondition_mat_lr_g(
+    grad_mat: jnp.ndarray,
+    q_a: jnp.ndarray,
+    q_g: jnp.ndarray,
+    d_a: jnp.ndarray,
+    d_g: jnp.ndarray,
+    rho_g: jnp.ndarray,
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Woodbury solve with only the G side truncated (``q_g [out, rG]``,
+    ``rho_g`` scalar); the A side keeps its full eigenbasis ``q_a [in, in]``.
+    Rotate fully on the A side, split captured/complement on the G side."""
+    lam = damping
+    g_a = jnp.matmul(grad_mat, q_a, precision=precision)  # [out, in]
+    t1 = jnp.matmul(q_g.T, g_a, precision=precision)  # [rG, in]
+    cap = t1 / (d_g[:, None] * d_a[None, :] + lam)
+    res = (g_a - jnp.matmul(q_g, t1, precision=precision)) / (
+        rho_g * d_a[None, :] + lam
+    )
+    return jnp.matmul(
+        jnp.matmul(q_g, cap, precision=precision) + res,
+        q_a.T,
+        precision=precision,
+    )
+
+
+def precondition_mat_lr_a(
+    grad_mat: jnp.ndarray,
+    q_a: jnp.ndarray,
+    q_g: jnp.ndarray,
+    d_a: jnp.ndarray,
+    d_g: jnp.ndarray,
+    rho_a: jnp.ndarray,
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Woodbury solve with only the A side truncated (``q_a [in, rA]``,
+    ``rho_a`` scalar); the G side keeps its full eigenbasis."""
+    lam = damping
+    g_g = jnp.matmul(q_g.T, grad_mat, precision=precision)  # [out, in]
+    t = jnp.matmul(g_g, q_a, precision=precision)  # [out, rA]
+    cap = t / (d_g[:, None] * d_a[None, :] + lam)
+    res = (g_g - jnp.matmul(t, q_a.T, precision=precision)) / (
+        d_g[:, None] * rho_a + lam
+    )
+    return jnp.matmul(
+        q_g,
+        jnp.matmul(cap, q_a.T, precision=precision) + res,
+        precision=precision,
+    )
+
+
+def precondition_mat_embed_lr_g(
+    grad_mat: jnp.ndarray,
+    q_g: jnp.ndarray,
+    d_g: jnp.ndarray,
+    rho_g: jnp.ndarray,
+    d_a: jnp.ndarray,
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Diagonal-A (embedding) layer with a truncated G side: the A rotations
+    are still the identity, and the G side splits captured/complement."""
+    lam = damping
+    t1 = jnp.matmul(q_g.T, grad_mat, precision=precision)  # [rG, vocab]
+    cap = jnp.matmul(
+        q_g, t1 / (d_g[:, None] * d_a[None, :] + lam), precision=precision
+    )
+    res = (grad_mat - jnp.matmul(q_g, t1, precision=precision)) / (
+        rho_g * d_a[None, :] + lam
+    )
+    return cap + res
+
+
+def entry_is_lowrank(e: Dict[str, jnp.ndarray]) -> bool:
+    """Whether an eigen-state entry carries a truncated (Woodbury) side."""
+    return "rhoA" in e or "rhoG" in e
+
+
+def solve_eigen_entry(
+    g: jnp.ndarray,
+    e: Dict[str, jnp.ndarray],
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+) -> jnp.ndarray:
+    """Dispatch one layer's eigenbasis solve on its state-entry keys.
+
+    Dense entries route to the exact pre-existing functions with identical
+    arguments (bit-for-bit inert when no ``rho*`` key is present); low-rank
+    entries route to the matching Woodbury form. The single dispatcher is
+    shared by the per-layer replicated loop, the vmapped stacked path, and
+    the owner-sharded distributed solve.
+    """
+    if "QA" not in e:  # diagonal-A (embedding) layer
+        if "rhoG" in e:
+            return precondition_mat_embed_lr_g(
+                g, e["QG"], e["dG"], e["rhoG"], e["dA"], damping, precision
+            )
+        return precondition_mat_embed(
+            g, e["QG"], e["dG"], e["dA"], damping, precision
+        )
+    lr_a, lr_g = "rhoA" in e, "rhoG" in e
+    if lr_a and lr_g:
+        return precondition_mat_lowrank(
+            g, e["QA"], e["QG"], e["dA"], e["dG"], e["rhoA"], e["rhoG"],
+            damping, precision,
+        )
+    if lr_g:
+        return precondition_mat_lr_g(
+            g, e["QA"], e["QG"], e["dA"], e["dG"], e["rhoG"], damping,
+            precision,
+        )
+    if lr_a:
+        return precondition_mat_lr_a(
+            g, e["QA"], e["QG"], e["dA"], e["dG"], e["rhoA"], damping,
+            precision,
+        )
+    return precondition_mat(
+        g, e["QA"], e["QG"], e["dA"], e["dG"], damping, precision
+    )
+
+
 def precondition_all(
     grad_mats: Dict[str, jnp.ndarray],
     eigen: Dict[str, Dict[str, jnp.ndarray]],
@@ -180,9 +365,8 @@ def precondition_all(
     # randomization, and dict insertion order feeds the KL-clip summation
     # order — cross-host bitwise determinism requires a fixed order
     for name in sorted(diag_a):
-        e = eigen[name]
-        out[name] = precondition_mat_embed(
-            grad_mats[name], e["QG"], e["dG"], e["dA"], damping, precision
+        out[name] = solve_eigen_entry(
+            grad_mats[name], eigen[name], damping, precision
         )
     shapes = {
         name: g.shape for name, g in grad_mats.items() if name not in diag_a
@@ -190,22 +374,27 @@ def precondition_all(
     for (go, ai), names in shape_groups(shapes).items():
         if len(names) == 1:
             name = names[0]
-            e = eigen[name]
-            out[name] = precondition_mat(
-                grad_mats[name], e["QA"], e["QG"], e["dA"], e["dG"], damping,
-                precision,
+            out[name] = solve_eigen_entry(
+                grad_mats[name], eigen[name], damping, precision
             )
             continue
         gm = jnp.stack([grad_mats[n] for n in names])  # [k, out, in]
         key = f"{go}x{ai}"
         if stacked is not None and key in stacked:
             s = stacked[key]
-            qa, qg, da, dg = s["QA"], s["QG"], s["dA"], s["dG"]
         else:
-            qa = jnp.stack([eigen[n]["QA"] for n in names])  # [k, in, in]
-            qg = jnp.stack([eigen[n]["QG"] for n in names])  # [k, out, out]
-            da = jnp.stack([eigen[n]["dA"] for n in names])  # [k, in]
-            dg = jnp.stack([eigen[n]["dG"] for n in names])  # [k, out]
+            keys = eigen[names[0]].keys()
+            s = {k: jnp.stack([eigen[n][k] for n in names]) for k in keys}
+        if entry_is_lowrank(s):
+            # vmap of the single-matrix Woodbury solve = the same batched
+            # matmuls the dense einsum chain gets, at the thin [n, r] shapes
+            v = jax.vmap(
+                lambda g, e: solve_eigen_entry(g, e, damping, precision)
+            )(gm, s)
+            for row, name in enumerate(names):
+                out[name] = v[row]
+            continue
+        qa, qg, da, dg = s["QA"], s["QG"], s["dA"], s["dG"]
         v1 = jnp.einsum("kji,kjl->kil", qg, gm, precision=precision)
         v1 = jnp.einsum("kil,klm->kim", v1, qa, precision=precision)
         v2 = v1 / (dg[:, :, None] * da[:, None, :] + damping)
@@ -356,13 +545,7 @@ def precondition_all_distributed(
     """
 
     def _solve(g, e, damp):
-        if "QA" not in e:  # diagonal-A (embedding) layer
-            return precondition_mat_embed(
-                g, e["QG"], e["dG"], e["dA"], damp, precision
-            )
-        return precondition_mat(
-            g, e["QA"], e["QG"], e["dA"], e["dG"], damp, precision
-        )
+        return solve_eigen_entry(g, e, damp, precision)
 
     return _apply_distributed(
         grad_mats, eigen, stacked, damping, mesh, owners, _solve, comm_dtype

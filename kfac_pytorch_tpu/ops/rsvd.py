@@ -1,0 +1,210 @@
+"""Randomized truncated eigensolve for K-FAC factors — matmul-only.
+
+The QDWH/syevd eigendecomposition the refresh pays per factor computes ALL n
+eigenpairs, but the preconditioner only needs the dominant curvature
+directions: Randomized K-FACs (arxiv 2206.15397) shows a rank-r randomized
+eigensolve preserves optimizer quality at a fraction of the decomposition
+cost. This module is the TPU-native realization: a Gaussian range finder,
+``passes`` rounds of subspace iteration, and a Rayleigh–Ritz projection —
+every O(n²·r) operation a batched matmul that feeds the MXU, with the only
+eigendecompositions the two ``(r+p)×(r+p)`` Rayleigh–Ritz solves (tiny, and
+independent of n). ``scripts/check_solver_hlo.py`` pins the matmul-only
+guarantee at the HLO level.
+
+The truncated basis is consumed as a low-rank-plus-diagonal curvature model
+
+    F  ≈  Q_r diag(d_r) Q_rᵀ + rho · (I − Q_r Q_rᵀ)
+
+where ``rho`` (the *residual trace mass*, :func:`residual_rho`) spreads the
+un-captured trace uniformly over the orthogonal complement. The matching
+Woodbury-style apply path lives in ops/precondition.py.
+
+Padding: same shape-bucket batching as ops/eigh.py (TPU compile cost is
+per-distinct-shape), but blocks embed into the ``m×m`` bucket with a ZERO pad
+— not the −1 diagonal of ``pad_for_eigh``. The −1 pad eigenvalues would have
+magnitude comparable to (or above) a small PSD spectrum and the power
+iteration would happily converge onto them; zero pad directions carry exactly
+zero energy, so ``A @ Ω`` never routes mass into the pad rows and the
+computed basis has exact zeros there — slicing ``Q[:n]`` recovers the
+unpadded basis.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kfac_pytorch_tpu.ops.eigh import bucket_size, symmetrize
+
+# Range-finder oversampling p and subspace-iteration passes q (arxiv
+# 2206.15397 uses small constants of this order; q=2 is enough for the
+# fast-decaying PSD spectra EMA'd K-FAC factors have in practice — the
+# spectrum-mass parity tests in tests/test_rsvd_solver.py pin the quality).
+DEFAULT_OVERSAMPLE = 8
+DEFAULT_PASSES = 2
+
+# Fixed seed for the Gaussian test matrix Ω: folded with the bucket size so
+# every bucket draws an independent sketch, yet every device/host derives the
+# SAME Ω (the sharded refresh computes each slot on one owner device and
+# psums — determinism requires no per-device randomness).
+_SKETCH_SEED = 20220630  # arxiv 2206.15397 v1 date
+
+
+def pad_for_rsvd(block: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Embed a symmetric ``n×n`` block into ``m×m`` with a ZERO pad.
+
+    See the module docstring for why the randomized solver must not reuse
+    ``pad_for_eigh``'s −1 pad diagonal.
+    """
+    n = block.shape[0]
+    if n == m:
+        return block
+    return jnp.zeros((m, m), block.dtype).at[:n, :n].set(block)
+
+
+def sketch_matrix(m: int, cols: int) -> jnp.ndarray:
+    """Deterministic ``[m, cols]`` Gaussian range-finder sketch for bucket
+    size ``m`` (same on every device — see ``_SKETCH_SEED``)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(_SKETCH_SEED), m)
+    return jax.random.normal(key, (m, cols), jnp.float32)
+
+
+def _orthonormalize(y: jnp.ndarray) -> jnp.ndarray:
+    """Orthonormalise the columns of a ``[k, m, cols]`` stack WITHOUT a QR
+    custom-call: ``M = YᵀY`` (cols×cols), ``Q = Y·M^{-1/2}`` via M's
+    eigendecomposition. One Gram pass leaves ``O(eps·cond(Y)²)`` error — the
+    Gram matrix squares the condition number — so a second pass on the
+    nearly-orthonormal result drives it to ~eps. The eigenvalue floor is
+    RELATIVE (a numerically rank-deficient direction gets a huge but finite
+    rescale; the next subspace-iteration multiply re-enriches it)."""
+    for _ in range(2):
+        gram = jnp.einsum(
+            "kir,kis->krs", y, y, precision=lax.Precision.HIGHEST
+        )
+        s, u = jnp.linalg.eigh(symmetrize(gram))
+        floor = 1e-12 * jnp.max(s, axis=-1, keepdims=True)
+        inv_sqrt = lax.rsqrt(jnp.maximum(s, jnp.maximum(floor, 1e-30)))
+        m_inv_half = jnp.einsum(
+            "krs,ks,kts->krt", u, inv_sqrt, u, precision=lax.Precision.HIGHEST
+        )
+        y = jnp.einsum(
+            "kir,krs->kis", y, m_inv_half, precision=lax.Precision.HIGHEST
+        )
+    return y
+
+
+def batched_randomized_eigh(
+    stack: jnp.ndarray,
+    rank: int,
+    eps: float = 1e-10,
+    oversample: int = DEFAULT_OVERSAMPLE,
+    passes: int = DEFAULT_PASSES,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Truncated eigensolve of a ``[k, m, m]`` stack of symmetric PSD blocks.
+
+    Returns ``(Q, d)`` with ``Q [k, m, rank]`` orthonormal columns and ``d
+    [k, rank]`` ASCENDING (matching ``jnp.linalg.eigh``'s order, so the
+    dense and truncated consumers index eigenvalues identically), floored at
+    ``eps`` like :func:`ops.eigh.eigh_with_floor`.
+
+    Algorithm (Halko-Martinsson-Tropp randomized range finder specialised to
+    symmetric PSD, the shape arxiv 2206.15397 applies to K-FAC factors):
+
+    1. ``Y = A·Ω`` with a Gaussian ``Ω [m, rank+p]``, then ``passes``
+       subspace-iteration rounds (multiply by ``A``, re-orthonormalise) —
+       orthonormalising after EVERY multiply is what keeps the sketch
+       numerically full-rank in f32; without it the columns collapse onto
+       the dominant eigenvector and the spectrum tail is unrecoverable.
+    2. Orthonormalisation is Gram-based (:func:`_orthonormalize`) — small
+       ``cols×cols`` eigh, no QR custom-call.
+    3. Rayleigh–Ritz: ``T = QᵀAQ`` (small), eigendecompose, rotate, keep the
+       top ``rank`` pairs.
+
+    Every m-sized operation is a batched matmul; the only ``eigh`` calls are
+    on ``(rank+p)×(rank+p)`` matrices.
+    """
+    k, m, _ = stack.shape
+    cols = min(rank + max(0, int(oversample)), m)
+    stack = symmetrize(stack)
+    omega = sketch_matrix(m, cols)
+    y = jnp.einsum("kij,jr->kir", stack, omega, precision=lax.Precision.HIGHEST)
+    y = _orthonormalize(y)
+    for _ in range(max(0, int(passes))):
+        y = jnp.einsum(
+            "kij,kjr->kir", stack, y, precision=lax.Precision.HIGHEST
+        )
+        y = _orthonormalize(y)
+    # Rayleigh–Ritz on the orthonormal range
+    aq = jnp.einsum(
+        "kij,kjr->kir", stack, y, precision=lax.Precision.HIGHEST
+    )
+    t_small = jnp.einsum(
+        "kir,kis->krs", y, aq, precision=lax.Precision.HIGHEST
+    )
+    t_eigs, v = jnp.linalg.eigh(symmetrize(t_small))
+    # eigh sorts ascending: the top `rank` pairs are the LAST rank columns,
+    # kept in ascending order to match the dense path's convention
+    v_top = v[:, :, cols - rank:]
+    d = t_eigs[:, cols - rank:]
+    q = jnp.einsum(
+        "kir,krs->kis", y, v_top, precision=lax.Precision.HIGHEST
+    )
+    d = d * (d > eps).astype(d.dtype)
+    return q, d
+
+
+def residual_rho(
+    trace: jnp.ndarray, d: jnp.ndarray, n: int, rank: int
+) -> jnp.ndarray:
+    """Residual trace mass per complement direction (the ``rho`` diagonal).
+
+    ``(tr(A) − Σ d_r) / (n − r)`` — the mean eigenvalue of the un-captured
+    spectrum, folded into the low-rank-plus-diagonal model as a uniform
+    diagonal on the orthogonal complement. Clipped at 0: the trace estimate
+    of a PSD factor minus its top eigenvalues is non-negative up to f32
+    roundoff, and a negative diagonal would flip update signs.
+    """
+    denom = max(int(n) - int(rank), 1)
+    return jnp.maximum(
+        (trace.astype(jnp.float32) - jnp.sum(d.astype(jnp.float32))) / denom,
+        0.0,
+    )
+
+
+def bucketed_rsvd_eigh(
+    blocks: List[jnp.ndarray],
+    rank: int,
+    eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
+    oversample: int = DEFAULT_OVERSAMPLE,
+    passes: int = DEFAULT_PASSES,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """Truncated-eigensolve a heterogeneous list of symmetric PSD blocks.
+
+    The rsvd twin of :func:`ops.eigh.bucketed_eigh`: jobs group into the same
+    padded shape buckets, each bucket runs ONE batched randomized eigensolve,
+    and results come back in input order as ``(Q_r [n, rank], d_r [rank],
+    rho)`` triples with the eigenvalue floor applied.
+    """
+    order = {}
+    for i, b in enumerate(blocks):
+        order.setdefault(
+            bucket_size(b.shape[0], granularity, minimum), []
+        ).append(i)
+    results: List[Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]] = (
+        [None] * len(blocks)  # type: ignore[list-item]
+    )
+    for m, idxs in sorted(order.items()):
+        stack = jnp.stack(
+            [pad_for_rsvd(symmetrize(blocks[i]), m) for i in idxs]
+        )
+        q, d = batched_randomized_eigh(stack, rank, eps, oversample, passes)
+        for row, i in enumerate(idxs):
+            n = blocks[i].shape[0]
+            rho = residual_rho(jnp.trace(blocks[i]), d[row], n, rank)
+            results[i] = (q[row, :n, :], d[row], rho)
+    return results

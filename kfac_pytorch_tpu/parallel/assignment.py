@@ -13,7 +13,39 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Matmul passes an rsvd slot pays over its bucket (see ops/rsvd.py): the
+# range-finder multiply, `passes` subspace-iteration multiplies, and the
+# Rayleigh–Ritz A·Q — each ~m²·cols MACs. Baked as a constant (not imported
+# from ops.rsvd) so the HOST-side planners stay import-light; the value only
+# shapes load balance, not numerics.
+_RSVD_MULTIPLIES = 4
+
+
+def _slot_cost(
+    size: int,
+    granularity: int,
+    minimum: int,
+    rank_fn: Optional[Callable[[int], Optional[int]]],
+) -> int:
+    """LPT cost of one eigh slot, rank-aware when a ``rank_fn`` is given.
+
+    Dense slots pay the padded eigendecomposition, ``bucket_size(size)³``.
+    A slot the randomized solver truncates (``rank_fn(size)`` returns a
+    rank) pays only its batched matmuls, ``m²·(r+p)·passes`` — orders of
+    magnitude lighter, and ignoring that would let the chunk planner stack
+    every truncated slot into one chunk thinking the load was balanced.
+    Deterministic integers either way, so every host derives the same plan.
+    """
+    from kfac_pytorch_tpu.ops.eigh import bucket_size
+    from kfac_pytorch_tpu.ops.rsvd import DEFAULT_OVERSAMPLE
+
+    m = bucket_size(size, granularity, minimum)
+    rank = rank_fn(size) if rank_fn is not None else None
+    if rank is None:
+        return m**3
+    return m * m * min(rank + DEFAULT_OVERSAMPLE, m) * _RSVD_MULTIPLIES
 
 
 class RoundRobin:
@@ -74,6 +106,7 @@ def plan_eigh_chunks(
     chunks: int,
     granularity: int = 512,
     minimum: int = 128,
+    rank_fn: Optional[Callable[[int], Optional[int]]] = None,
 ) -> List[List[int]]:
     """Partition eigh slots into ``chunks`` balanced pieces for the pipelined
     refresh (one piece per post-boundary step).
@@ -85,11 +118,11 @@ def plan_eigh_chunks(
     plan from the same (layer set, diag_blocks, chunks) tuple and the chunk
     id can be a static jit argument. Chunks may come back empty when there
     are fewer slots than chunks — an empty chunk's step is just a plain step.
+    ``rank_fn`` makes the cost rank-aware for the randomized solver (see
+    :func:`_slot_cost`); ``None`` keeps the dense cost exactly as before.
     """
-    from kfac_pytorch_tpu.ops.eigh import bucket_size
-
     cost = {
-        i: bucket_size(s.size, granularity, minimum) ** 3
+        i: _slot_cost(s.size, granularity, minimum, rank_fn)
         for i, s in enumerate(slots)
     }
     order = sorted(
@@ -108,7 +141,11 @@ def plan_eigh_chunks(
 
 
 def eigh_chunk_owners(
-    slots, world: int, granularity: int = 512, minimum: int = 128
+    slots,
+    world: int,
+    granularity: int = 512,
+    minimum: int = 128,
+    rank_fn: Optional[Callable[[int], Optional[int]]] = None,
 ) -> List[int]:
     """Per-slot owner devices for ONE chunk's slots, balanced over the mesh.
 
@@ -117,11 +154,9 @@ def eigh_chunk_owners(
     work onto a few devices. Re-run greedy LPT (same ``bucket_size³`` cost
     and deterministic tie-breaks as :func:`plan_eigh_chunks`) over just the
     chunk's slots so each pipelined step spreads its eigh work across all
-    ``world`` devices.
+    ``world`` devices. ``rank_fn`` mirrors :func:`plan_eigh_chunks`.
     """
-    from kfac_pytorch_tpu.ops.eigh import bucket_size
-
-    cost = [bucket_size(s.size, granularity, minimum) ** 3 for s in slots]
+    cost = [_slot_cost(s.size, granularity, minimum, rank_fn) for s in slots]
     order = sorted(
         range(len(slots)),
         key=lambda i: (-cost[i], slots[i].name, slots[i].factor, slots[i].start),

@@ -42,10 +42,43 @@ from kfac_pytorch_tpu.ops.eigh import (
     bucket_size,
     get_block_boundary,
     pad_for_eigh,
+    symmetrize,
     unpad_eigh,
+)
+from kfac_pytorch_tpu.ops.rsvd import (
+    batched_randomized_eigh,
+    pad_for_rsvd,
+    residual_rho,
 )
 
 Assignment = Dict[str, Dict[str, Tuple[int, ...]]]
+
+
+# A slot's refresh result: dense slots yield (Q [n, n], d [n]); slots the
+# randomized solver truncates yield (Q_r [n, r], d_r [r], rho). Tuple arity
+# is the discriminator throughout this module.
+
+
+def _split_by_rank(
+    slots: List[EighSlot], rank_fn
+) -> Tuple[List[int], Dict[int, List[int]]]:
+    """Partition slot indices into (dense, {rank: [indices]}) per ``rank_fn``.
+
+    ``rank_fn(size) -> Optional[int]`` is the single size→rank policy (the
+    preconditioner's solver_rank/solver_auto_threshold rule); ``None`` for a
+    size means the dense eigh keeps that slot. Shared by every update path so
+    the replicated, sharded, monolithic, and chunked variants truncate the
+    exact same slot set.
+    """
+    dense: List[int] = []
+    by_rank: Dict[int, List[int]] = {}
+    for i, s in enumerate(slots):
+        r = rank_fn(s.size) if rank_fn is not None else None
+        if r is None:
+            dense.append(i)
+        else:
+            by_rank.setdefault(int(r), []).append(i)
+    return dense, by_rank
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,30 +145,102 @@ def _padded_stack(
         s = slots[i]
         f = factors[s.name][s.factor]
         blk = f[s.start : s.stop, s.start : s.stop].astype(jnp.float32)
-        rows.append(pad_for_eigh(0.5 * (blk + blk.T), m))
+        rows.append(pad_for_eigh(symmetrize(blk), m))
     return jnp.stack(rows)
+
+
+def _rsvd_stack(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    slots: List[EighSlot],
+    idxs: List[int],
+    m: int,
+) -> jnp.ndarray:
+    """Zero-padded bucket stack for the randomized solver (pad_for_rsvd —
+    the −1 pad diagonal of the dense path would dominate the power
+    iteration on small PSD spectra)."""
+    rows = []
+    for i in idxs:
+        s = slots[i]
+        f = factors[s.name][s.factor]
+        blk = f[s.start : s.stop, s.start : s.stop].astype(jnp.float32)
+        rows.append(pad_for_rsvd(symmetrize(blk), m))
+    return jnp.stack(rows)
+
+
+def _rank_groups(
+    slots: List[EighSlot],
+    rank_fn,
+    granularity: int,
+    minimum: int,
+) -> Tuple[Dict[int, List[int]], Dict[Tuple[int, int], List[int]]]:
+    """Split slots into dense bucket groups and ``(bucket, rank)`` rsvd
+    groups, both carrying GLOBAL slot indices. With ``rank_fn=None`` the
+    dense groups equal :func:`_bucket_groups` exactly (bitwise-inert)."""
+    dense_idx, by_rank = _split_by_rank(slots, rank_fn)
+    groups: Dict[int, List[int]] = {}
+    for i in dense_idx:
+        groups.setdefault(
+            bucket_size(slots[i].size, granularity, minimum), []
+        ).append(i)
+    lr_groups: Dict[Tuple[int, int], List[int]] = {}
+    for r, idxs in sorted(by_rank.items()):
+        for i in idxs:
+            lr_groups.setdefault(
+                (bucket_size(slots[i].size, granularity, minimum), r), []
+            ).append(i)
+    return dict(sorted(groups.items())), dict(sorted(lr_groups.items()))
+
+
+def _owner_tables(
+    slots: List[EighSlot], idxs: List[int], world: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-device (row indices, validity mask) tables for one bucket group:
+    device ``dev`` owns stack rows ``idx_tab[dev][:count]``; rows past its
+    count point at row 0 and are masked out by ``valid``."""
+    owned = [
+        [r for r, i in enumerate(idxs) if slots[i].owner == dev]
+        for dev in range(world)
+    ]
+    rows = max(1, max(len(o) for o in owned))
+    idx_tab = [(o + [0] * (rows - len(o))) for o in owned]
+    valid = [[1.0] * len(o) + [0.0] * (rows - len(o)) for o in owned]
+    return jnp.asarray(idx_tab, jnp.int32), jnp.asarray(valid, jnp.float32)
 
 
 def _assemble(
     factors: Dict[str, Dict[str, jnp.ndarray]],
     slots: List[EighSlot],
-    results: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]],
+    results: Dict[int, Tuple[jnp.ndarray, ...]],
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
-    """Scatter per-slot (Q, d) into per-layer block-diagonal eigen buffers."""
+    """Scatter per-slot results into per-layer eigen buffers.
+
+    Dense ``(Q, d)`` results scatter into zeroed block-diagonal buffers; a
+    truncated ``(Q_r, d_r, rho)`` result IS its factor's whole eigen entry
+    (the randomized solver is excluded from ``diag_blocks > 1``, so a
+    truncated slot always spans its full factor) and is stored rectangular
+    plus the scalar residual mass — no zero buffer ever materializes for it.
+    """
+    lr_pairs = {
+        (s.name, s.factor) for i, s in enumerate(slots) if len(results[i]) == 3
+    }
     eigen: Dict[str, Dict[str, jnp.ndarray]] = {}
     for name, f in factors.items():
         eigen[name] = {}
-        if "A" in f:
-            na = f["A"].shape[0]
-            eigen[name]["QA"] = jnp.zeros((na, na), jnp.float32)
-            eigen[name]["dA"] = jnp.zeros((na,), jnp.float32)
-        if "G" in f:
-            ng = f["G"].shape[0]
-            eigen[name]["QG"] = jnp.zeros((ng, ng), jnp.float32)
-            eigen[name]["dG"] = jnp.zeros((ng,), jnp.float32)
+        for fac, qk, dk in (("A", "QA", "dA"), ("G", "QG", "dG")):
+            if fac in f and (name, fac) not in lr_pairs:
+                n = f[fac].shape[0]
+                eigen[name][qk] = jnp.zeros((n, n), jnp.float32)
+                eigen[name][dk] = jnp.zeros((n,), jnp.float32)
     for i, s in enumerate(slots):
-        q, d = results[i]
+        res = results[i]
         qk, dk = ("QA", "dA") if s.factor == "A" else ("QG", "dG")
+        if len(res) == 3:
+            q, d, rho = res
+            eigen[s.name][qk] = q
+            eigen[s.name][dk] = d
+            eigen[s.name]["rhoA" if s.factor == "A" else "rhoG"] = rho
+            continue
+        q, d = res
         eigen[s.name][qk] = (
             eigen[s.name][qk].at[s.start : s.stop, s.start : s.stop].set(q)
         )
@@ -151,6 +256,7 @@ def sharded_eigen_update(
     eps: float = 1e-10,
     granularity: int = 512,
     minimum: int = 128,
+    rank_fn=None,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Recompute all layers' eigendecompositions, sharded over the WHOLE mesh.
 
@@ -163,24 +269,28 @@ def sharded_eigen_update(
     axes to begin with; every rank is an eigh worker,
     kfac_preconditioner.py:383-396). ``axis_name`` is unused and kept for
     call-site compatibility.
+
+    ``rank_fn`` (solver="rsvd") diverts slots it maps to a rank into the
+    randomized truncated solve: their buckets run batched matmuls instead of
+    QDWH eigh and their sum-of-zeros exchange psums the far smaller
+    ``[k, m, r]``/``[k, r]`` tables — the broadcast-bytes win scales with
+    n/r. The residual mass ``rho`` is computed from the replicated factor
+    trace, so it needs no exchange at all.
     """
     del axis_name
     axes = tuple(mesh.axis_names)
     world = mesh.devices.size
     slots = build_slots(factors, assignment)
-    groups = _bucket_groups(slots, granularity, minimum)
+    groups, lr_groups = _rank_groups(slots, rank_fn, granularity, minimum)
 
     # Host-side per-bucket index tables: device -> the stack rows it owns.
-    tables = {}
-    for m, idxs in groups.items():
-        owned = [[r for r, i in enumerate(idxs) if slots[i].owner == dev] for dev in range(world)]
-        rows = max(1, max(len(o) for o in owned))
-        idx_tab = [(o + [0] * (rows - len(o))) for o in owned]
-        valid = [[1.0] * len(o) + [0.0] * (rows - len(o)) for o in owned]
-        tables[m] = (
-            jnp.asarray(idx_tab, jnp.int32),
-            jnp.asarray(valid, jnp.float32),
-        )
+    tables = {
+        m: _owner_tables(slots, idxs, world) for m, idxs in groups.items()
+    }
+    lr_tables = {
+        key: _owner_tables(slots, idxs, world)
+        for key, idxs in lr_groups.items()
+    }
 
     @partial(
         compat.shard_map,
@@ -198,7 +308,7 @@ def sharded_eigen_update(
         dev = lax.axis_index(axes[0])
         for a in axes[1:]:
             dev = dev * mesh.shape[a] + lax.axis_index(a)
-        per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        per_slot: Dict[int, Tuple[jnp.ndarray, ...]] = {}
         for m, idxs in groups.items():
             with tel.span("trace/eigh/compute"):
                 all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
@@ -218,6 +328,29 @@ def sharded_eigen_update(
                 kd = lax.psum(kd, axes)
             for row, i in enumerate(idxs):
                 per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
+        for (m, rank), idxs in lr_groups.items():
+            with tel.span("trace/eigh/compute"):
+                all_blocks = _rsvd_stack(facs, slots, idxs, m)  # [k, m, m]
+                idx_tab, valid = lr_tables[(m, rank)]
+                mine = jnp.take(idx_tab, dev, axis=0)
+                vmask = jnp.take(valid, dev, axis=0)
+                stack = jnp.take(all_blocks, mine, axis=0)
+                q, d = batched_randomized_eigh(stack, rank, eps)
+                q = q * vmask[:, None, None]
+                d = d * vmask[:, None]
+            k = len(idxs)
+            with tel.span("trace/eigh/exchange"):
+                kq = jnp.zeros((k, m, rank), jnp.float32).at[mine].add(q)
+                kd = jnp.zeros((k, rank), jnp.float32).at[mine].add(d)
+                kq = lax.psum(kq, axes)
+                kd = lax.psum(kd, axes)
+            for row, i in enumerate(idxs):
+                s = slots[i]
+                blk = facs[s.name][s.factor][
+                    s.start : s.stop, s.start : s.stop
+                ].astype(jnp.float32)
+                rho = residual_rho(jnp.trace(blk), kd[row], s.size, rank)
+                per_slot[i] = (kq[row, : s.size, :], kd[row], rho)
         return _assemble(facs, slots, per_slot)
 
     return _inner(factors)
@@ -240,9 +373,18 @@ def _scatter_into(
     """
     out = {name: dict(e) for name, e in pending.items()}
     for i, s in enumerate(slots):
-        q, d = results[i]
+        res = results[i]
         qk, dk = ("QA", "dA") if s.factor == "A" else ("QG", "dG")
         buf = out[s.name][qk]
+        if len(res) == 3:
+            # truncated slot: whole-factor span guaranteed (rsvd excludes
+            # diag_blocks > 1), so the chunk overwrites the entire entry
+            q, d, rho = res
+            out[s.name][qk] = q.astype(buf.dtype)
+            out[s.name][dk] = d
+            out[s.name]["rhoA" if s.factor == "A" else "rhoG"] = rho
+            continue
+        q, d = res
         out[s.name][qk] = (
             buf.at[s.start : s.stop, s.start : s.stop].set(q.astype(buf.dtype))
         )
@@ -258,6 +400,7 @@ def sharded_eigen_chunk_update(
     eps: float = 1e-10,
     granularity: int = 512,
     minimum: int = 128,
+    rank_fn=None,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """One chunk of the pipelined refresh, sharded over the WHOLE mesh.
 
@@ -265,27 +408,25 @@ def sharded_eigen_chunk_update(
     tables, one batched eigh per bucket, sum-of-zeros psum — restricted to
     ``chunk_slots`` and scattering results into the replicated ``pending``
     buffers instead of assembling from zeros. Owners are rebalanced WITHIN
-    the chunk (``eigh_chunk_owners``) so each pipelined step spreads its
-    fraction of the eigh work across all devices.
+    the chunk (``eigh_chunk_owners``, rank-aware when ``rank_fn`` is set) so
+    each pipelined step spreads its fraction of the eigh work across all
+    devices.
     """
     from kfac_pytorch_tpu.parallel.assignment import eigh_chunk_owners
 
     axes = tuple(mesh.axis_names)
     world = mesh.devices.size
-    owners = eigh_chunk_owners(chunk_slots, world, granularity, minimum)
+    owners = eigh_chunk_owners(chunk_slots, world, granularity, minimum, rank_fn)
     slots = [dataclasses.replace(s, owner=o) for s, o in zip(chunk_slots, owners)]
-    groups = _bucket_groups(slots, granularity, minimum)
+    groups, lr_groups = _rank_groups(slots, rank_fn, granularity, minimum)
 
-    tables = {}
-    for m, idxs in groups.items():
-        owned = [[r for r, i in enumerate(idxs) if slots[i].owner == dev] for dev in range(world)]
-        rows = max(1, max(len(o) for o in owned))
-        idx_tab = [(o + [0] * (rows - len(o))) for o in owned]
-        valid = [[1.0] * len(o) + [0.0] * (rows - len(o)) for o in owned]
-        tables[m] = (
-            jnp.asarray(idx_tab, jnp.int32),
-            jnp.asarray(valid, jnp.float32),
-        )
+    tables = {
+        m: _owner_tables(slots, idxs, world) for m, idxs in groups.items()
+    }
+    lr_tables = {
+        key: _owner_tables(slots, idxs, world)
+        for key, idxs in lr_groups.items()
+    }
 
     @partial(
         compat.shard_map,
@@ -299,7 +440,7 @@ def sharded_eigen_chunk_update(
         dev = lax.axis_index(axes[0])
         for a in axes[1:]:
             dev = dev * mesh.shape[a] + lax.axis_index(a)
-        per_slot: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        per_slot: Dict[int, Tuple[jnp.ndarray, ...]] = {}
         for m, idxs in groups.items():
             with tel.span("trace/eigh/compute"):
                 all_blocks = _padded_stack(facs, slots, idxs, m)  # [k, m, m]
@@ -318,6 +459,29 @@ def sharded_eigen_chunk_update(
                 kd = lax.psum(kd, axes)
             for row, i in enumerate(idxs):
                 per_slot[i] = unpad_eigh(kq[row], kd[row], slots[i].size, eps)
+        for (m, rank), idxs in lr_groups.items():
+            with tel.span("trace/eigh/compute"):
+                all_blocks = _rsvd_stack(facs, slots, idxs, m)
+                idx_tab, valid = lr_tables[(m, rank)]
+                mine = jnp.take(idx_tab, dev, axis=0)
+                vmask = jnp.take(valid, dev, axis=0)
+                stack = jnp.take(all_blocks, mine, axis=0)
+                q, d = batched_randomized_eigh(stack, rank, eps)
+                q = q * vmask[:, None, None]
+                d = d * vmask[:, None]
+            k = len(idxs)
+            with tel.span("trace/eigh/exchange"):
+                kq = jnp.zeros((k, m, rank), jnp.float32).at[mine].add(q)
+                kd = jnp.zeros((k, rank), jnp.float32).at[mine].add(d)
+                kq = lax.psum(kq, axes)
+                kd = lax.psum(kd, axes)
+            for row, i in enumerate(idxs):
+                s = slots[i]
+                blk = facs[s.name][s.factor][
+                    s.start : s.stop, s.start : s.stop
+                ].astype(jnp.float32)
+                rho = residual_rho(jnp.trace(blk), kd[row], s.size, rank)
+                per_slot[i] = (kq[row, : s.size, :], kd[row], rho)
         return per_slot
 
     # the post-psum results are replicated, so the pending-buffer scatter can
@@ -332,19 +496,49 @@ def replicated_eigen_chunk_update(
     eps: float = 1e-10,
     granularity: int = 512,
     minimum: int = 128,
+    rank_fn=None,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Single-device chunk path: the chunk's jobs, bucketed, scattered into
     ``pending`` (the world=1 twin of :func:`sharded_eigen_chunk_update`)."""
-    from kfac_pytorch_tpu.ops.eigh import bucketed_eigh
+    results = _replicated_results(
+        factors, chunk_slots, eps, granularity, minimum, rank_fn
+    )
+    return _scatter_into(pending, chunk_slots, results)
 
-    blocks = [
-        factors[s.name][s.factor][s.start : s.stop, s.start : s.stop].astype(
-            jnp.float32
+
+def _replicated_results(
+    factors: Dict[str, Dict[str, jnp.ndarray]],
+    slots: List[EighSlot],
+    eps: float,
+    granularity: int,
+    minimum: int,
+    rank_fn,
+) -> Dict[int, Tuple[jnp.ndarray, ...]]:
+    """Local (world=1) per-slot solves: dense slots through ``bucketed_eigh``,
+    rank-mapped slots through ``bucketed_rsvd_eigh`` — the single-device twin
+    of the sharded dense/LR bucket split."""
+    from kfac_pytorch_tpu.ops.eigh import bucketed_eigh
+    from kfac_pytorch_tpu.ops.rsvd import bucketed_rsvd_eigh
+
+    def _block(s: EighSlot) -> jnp.ndarray:
+        return factors[s.name][s.factor][
+            s.start : s.stop, s.start : s.stop
+        ].astype(jnp.float32)
+
+    dense_idx, by_rank = _split_by_rank(slots, rank_fn)
+    results: Dict[int, Tuple[jnp.ndarray, ...]] = {}
+    dense = bucketed_eigh(
+        [_block(slots[i]) for i in dense_idx], eps, granularity, minimum
+    )
+    for j, i in enumerate(dense_idx):
+        results[i] = dense[j]
+    for rank, idxs in sorted(by_rank.items()):
+        lr = bucketed_rsvd_eigh(
+            [_block(slots[i]) for i in idxs], rank, eps, granularity, minimum
         )
-        for s in chunk_slots
-    ]
-    results = bucketed_eigh(blocks, eps, granularity, minimum)
-    return _scatter_into(pending, chunk_slots, dict(enumerate(results)))
+        for j, i in enumerate(idxs):
+            results[i] = lr[j]
+    return results
 
 
 def replicated_eigen_update(
@@ -353,20 +547,15 @@ def replicated_eigen_update(
     eps: float = 1e-10,
     granularity: int = 512,
     minimum: int = 128,
+    rank_fn=None,
 ) -> Dict[str, Dict[str, jnp.ndarray]]:
     """Single-device path: every job computed locally, still shape-bucketed.
 
     Identical math to :func:`sharded_eigen_update` with world=1 — the bucketed
     batched eigh is what keeps single-chip ResNet-50 compile times sane.
     """
-    from kfac_pytorch_tpu.ops.eigh import bucketed_eigh
-
     slots = build_slots(factors, None, diag_blocks_per_layer)
-    blocks = [
-        factors[s.name][s.factor][s.start : s.stop, s.start : s.stop].astype(
-            jnp.float32
-        )
-        for s in slots
-    ]
-    results = bucketed_eigh(blocks, eps, granularity, minimum)
-    return _assemble(factors, slots, dict(enumerate(results)))
+    results = _replicated_results(
+        factors, slots, eps, granularity, minimum, rank_fn
+    )
+    return _assemble(factors, slots, results)

@@ -61,6 +61,20 @@ PyTree = Any
 KFACState = Dict[str, Any]
 
 
+def _side_spectrum(e: Dict[str, jnp.ndarray], side: str) -> jnp.ndarray:
+    """One side's eigenvalue spectrum for the health diagnostics. A truncated
+    side's stored ``d`` covers only the captured subspace; appending its
+    residual mass ``rho`` (the eigenvalue of every complement direction in
+    the low-rank-plus-diagonal model) keeps min/max damped-eig and condition
+    numbers meaningful — without it a well-conditioned truncated factor
+    would read as having no small eigenvalues at all."""
+    d = e[f"d{side}"]
+    rho = e.get(f"rho{side}")
+    if rho is None:
+        return d
+    return jnp.concatenate([d, jnp.reshape(rho, (1,)).astype(d.dtype)])
+
+
 @dataclasses.dataclass
 class KFACHParams:
     """Host-side mutable hyperparameters (the ``param_groups`` analog).
@@ -123,6 +137,9 @@ class KFAC:
         factor_kernel: str = "auto",
         factor_comm_dtype: Any = "f32",
         factor_comm_freq: int = 1,
+        solver: str = "eigh",
+        solver_rank: int = 128,
+        solver_auto_threshold: int = 512,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -243,6 +260,43 @@ class KFAC:
                 "to spread, so refusing a config that implies one"
             )
         self.eigh_chunks = int(eigh_chunks)
+        # Curvature solver for the refresh: "eigh" (full QDWH/syevd
+        # eigendecomposition, reference parity, bitwise-inert default) or
+        # "rsvd" (randomized truncated eigensolve, ops/rsvd.py): factors with
+        # side n ≥ solver_auto_threshold keep only their top solver_rank
+        # eigenpairs plus a residual-trace diagonal, refresh via batched
+        # matmuls instead of eigh custom-calls, and precondition through the
+        # low-rank-plus-diagonal Woodbury path (ops/precondition.py). Factors
+        # below the threshold — or with solver_rank ≥ n, where truncation
+        # buys nothing — stay on the dense path unchanged.
+        _validate("solver", solver in ("eigh", "rsvd"), solver)
+        _validate(
+            "solver_rank",
+            isinstance(solver_rank, int) and 0 < solver_rank,
+            solver_rank,
+        )
+        _validate(
+            "solver_auto_threshold",
+            isinstance(solver_auto_threshold, int) and 0 < solver_auto_threshold,
+            solver_auto_threshold,
+        )
+        if solver == "rsvd" and precond_method == "inverse":
+            raise ValueError(
+                "solver='rsvd' produces a truncated eigenbasis consumed by "
+                "the eigenbasis (Woodbury) apply path; precond_method="
+                "'inverse' preconditions with explicit Cholesky inverses and "
+                "would silently ignore the configured solver"
+            )
+        if solver == "rsvd" and diag_blocks != 1:
+            raise ValueError(
+                "solver='rsvd' stores one (Q_r, d_r, rho) triple per whole "
+                "factor; diag_blocks > 1 carves factors into diagonal blocks "
+                "whose truncated bases cannot share that layout — pick one "
+                "approximation"
+            )
+        self.solver = solver
+        self.solver_rank = int(solver_rank)
+        self.solver_auto_threshold = int(solver_auto_threshold)
         # Stability telemetry (costs two scalars of state + O(layers) mins):
         # ν — the KL trust-region coefficient actually applied each step
         # (kfac_preconditioner.py:320-326) — and the minimum damped
@@ -331,6 +385,61 @@ class KFAC:
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
         return names, is_conv
 
+    def _rank_for(self, n: int) -> Optional[int]:
+        """The single size→rank policy: the rank the randomized solver keeps
+        for a factor side of size ``n``, or ``None`` for the dense path.
+
+        ``solver_rank >= n`` falls back to dense — truncation would buy
+        nothing, and keeping those sides dense makes ``r ≥ n`` configurations
+        exactly bitwise-equal to ``solver="eigh"``. A pure function of the
+        side size, so every slot in a shape bucket (and every host) derives
+        the same answer; init(), the refresh planners, and the sharded
+        updates all route through here.
+        """
+        if self.solver != "rsvd":
+            return None
+        if n < self.solver_auto_threshold or self.solver_rank >= n:
+            return None
+        return self.solver_rank
+
+    def _rank_fn(self):
+        """``rank_fn`` to thread into the refresh planners/updates: ``None``
+        (not a function) when the solver is dense, so those paths stay
+        bitwise-identical to the pre-solver code."""
+        return self._rank_for if self.solver == "rsvd" else None
+
+    def _spectrum_mass(
+        self,
+        facs: Dict[str, Dict[str, jnp.ndarray]],
+        eigen_full: Dict[str, Dict[str, jnp.ndarray]],
+        names,
+    ) -> jnp.ndarray:
+        """Fraction of total factor trace captured by the truncated bases.
+
+        ``Σ d_r / Σ tr(F)`` summed over every low-rank factor side — the
+        scalar behind the ``kfac/spectrum_mass_captured`` gauge. Near 1.0
+        means the configured rank covers the curvature spectrum; a sagging
+        value is the signal to raise ``solver_rank``. Exactly 1.0 when no
+        side is truncated (nothing was discarded).
+        """
+        cap = jnp.zeros((), jnp.float32)
+        tot = jnp.zeros((), jnp.float32)
+        any_lr = False
+        for n in names:
+            e = eigen_full[n]
+            for d_key, rho_key, f_key in (
+                ("dA", "rhoA", "A"),
+                ("dG", "rhoG", "G"),
+            ):
+                if rho_key not in e:
+                    continue
+                any_lr = True
+                cap = cap + jnp.sum(e[d_key].astype(jnp.float32))
+                tot = tot + jnp.trace(facs[n][f_key].astype(jnp.float32))
+        if not any_lr:
+            return jnp.ones((), jnp.float32)
+        return cap / jnp.maximum(tot, 1e-30)
+
     def _world(self) -> int:
         # Eigendecomposition work shards over EVERY device of the mesh —
         # owners in the assignment table are flat device indices (row-major
@@ -344,6 +453,24 @@ class KFAC:
     # ------------------------------------------------------------------
     # State
     # ------------------------------------------------------------------
+
+    def _eigen_side_init(self, side: str, n: int) -> Dict[str, jnp.ndarray]:
+        """Zero eigen-state entries for one factor side, shaped by the solver
+        policy: dense sides get the square ``Q``/full ``d``; sides the
+        randomized solver truncates (:meth:`_rank_for`) get rectangular
+        ``[n, r]``/``[r]`` buffers plus the scalar residual mass — the state
+        layout is fixed from init so refreshes never retrace the step."""
+        rank = self._rank_for(n)
+        if rank is None:
+            return {
+                f"Q{side}": jnp.zeros((n, n), self.eigen_dtype),
+                f"d{side}": jnp.zeros((n,), jnp.float32),
+            }
+        return {
+            f"Q{side}": jnp.zeros((n, rank), self.eigen_dtype),
+            f"d{side}": jnp.zeros((rank,), jnp.float32),
+            f"rho{side}": jnp.zeros((), jnp.float32),
+        }
 
     def init(self, params: PyTree) -> KFACState:
         """Identity factors + zero eigen state (kfac_preconditioner.py:155-165).
@@ -378,8 +505,7 @@ class KFAC:
                 else:
                     eigen[name] = {
                         "dA": jnp.zeros((vocab,), jnp.float32),
-                        "QG": jnp.zeros((feats, feats), self.eigen_dtype),
-                        "dG": jnp.zeros((feats,), jnp.float32),
+                        **self._eigen_side_init("G", feats),
                     }
                 continue
             kernel = node["kernel"]
@@ -407,10 +533,8 @@ class KFAC:
                 }
             else:
                 eigen[name] = {
-                    "QA": jnp.zeros((a_side, a_side), self.eigen_dtype),
-                    "dA": jnp.zeros((a_side,), jnp.float32),
-                    "QG": jnp.zeros((g_side, g_side), self.eigen_dtype),
-                    "dG": jnp.zeros((g_side,), jnp.float32),
+                    **self._eigen_side_init("A", a_side),
+                    **self._eigen_side_init("G", g_side),
                 }
         # same-shape groups live ONLY pre-stacked (batched-rotation form);
         # singleton shapes stay per-layer — see split_eigen_state
@@ -432,6 +556,12 @@ class KFAC:
             # monolithic configuration's pytree (and checkpoints) are
             # untouched.
             state["eigen_pending"] = {n: dict(e) for n, e in eigen.items()}
+        if self.solver == "rsvd":
+            # Fraction of total factor trace the truncated bases captured at
+            # the last refresh (1.0 when no side crossed the threshold) —
+            # the in-graph source of the kfac/spectrum_mass_captured gauge.
+            # Fixed from init like the other optional state keys.
+            state["spectrum_mass"] = jnp.zeros((), jnp.float32)
         if self.factor_comm.defer:
             # Deferred factor communication: the factor running averages
             # double as per-replica LOCAL accumulators between flushes (no
@@ -615,6 +745,7 @@ class KFAC:
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
         pending = state.get("eigen_pending")
+        spectrum_mass = state.get("spectrum_mass")
         # Per-layer eigenvalue spectra captured (pre-split) on eigen-update
         # steps for the health diagnostics; None on every other path.
         fresh_spectra = None
@@ -657,13 +788,16 @@ class KFAC:
                         diag_blocks,
                     )
                     eigen = sharded_eigen_update(
-                        facs, table, self.mesh, self.axis_name, self.eps
+                        facs, table, self.mesh, self.axis_name, self.eps,
+                        rank_fn=self._rank_fn(),
                     )
                 else:
                     blocks = {
                         name: (diag_blocks if is_conv[name] else 1) for name in names
                     }
-                    eigen = replicated_eigen_update(facs, blocks, self.eps)
+                    eigen = replicated_eigen_update(
+                        facs, blocks, self.eps, rank_fn=self._rank_fn()
+                    )
                 # Diagonal-A (embedding) layers: the A "eigendecomposition" is
                 # the diagonal itself (eigenvectors = identity) — no eigh, just
                 # the reference's eigenvalue floor (kfac_preconditioner.py:253).
@@ -671,11 +805,17 @@ class KFAC:
                     if "A_diag" in facs[n]:
                         d = facs[n]["A_diag"]
                         eigen[n]["dA"] = d * (d > self.eps)
+                if self.solver == "rsvd":
+                    spectrum_mass = self._spectrum_mass(facs, eigen, names)
                 if self.track_diagnostics:
                     # grab the f32 per-layer spectra while the eigen dict is
                     # still in full per-layer form (stacks lose layer keys)
                     fresh_spectra = {
-                        n: (eigen[n]["dA"], eigen[n]["dG"]) for n in names
+                        n: (
+                            _side_spectrum(eigen[n], "A"),
+                            _side_spectrum(eigen[n], "G"),
+                        )
+                        for n in names
                     }
                 if self.eigen_dtype != jnp.float32:
                     # eigh itself always runs f32; only the stored/streamed Q
@@ -712,7 +852,10 @@ class KFAC:
                     name: (diag_blocks if is_conv[name] else 1) for name in names
                 }
                 slots = build_slots(facs, None, blocks)
-            chunk_slots = [slots[i] for i in plan_eigh_chunks(slots, k)[c]]
+            chunk_slots = [
+                slots[i]
+                for i in plan_eigh_chunks(slots, k, rank_fn=self._rank_fn())[c]
+            ]
             if c == 0:
                 # Fresh interval: zero the whole double buffer so the swap
                 # sees exactly what a from-zeros _assemble would build —
@@ -723,11 +866,13 @@ class KFAC:
                 if chunk_slots:
                     if world > 1:
                         pending = sharded_eigen_chunk_update(
-                            facs, pending, chunk_slots, self.mesh, self.eps
+                            facs, pending, chunk_slots, self.mesh, self.eps,
+                            rank_fn=self._rank_fn(),
                         )
                     else:
                         pending = replicated_eigen_chunk_update(
-                            facs, pending, chunk_slots, self.eps
+                            facs, pending, chunk_slots, self.eps,
+                            rank_fn=self._rank_fn(),
                         )
             if swap_eigen:
                 # Atomic swap: every chunk has landed (EigenRefreshCadence
@@ -741,9 +886,15 @@ class KFAC:
                     if "A_diag" in facs[n]:
                         d = facs[n]["A_diag"]
                         full[n]["dA"] = d * (d > self.eps)
+                if self.solver == "rsvd":
+                    spectrum_mass = self._spectrum_mass(facs, full, names)
                 if self.track_diagnostics:
                     fresh_spectra = {
-                        n: (full[n]["dA"], full[n]["dG"]) for n in names
+                        n: (
+                            _side_spectrum(full[n], "A"),
+                            _side_spectrum(full[n], "G"),
+                        )
+                        for n in names
                     }
                 eigen, stacked = precond_ops.split_eigen_state(full)
 
@@ -798,6 +949,8 @@ class KFAC:
         }
         if pending is not None:
             new_state["eigen_pending"] = pending
+        if spectrum_mass is not None:
+            new_state["spectrum_mass"] = spectrum_mass
         if "factor_sync_age" in state:
             new_state["factor_sync_age"] = (
                 jnp.zeros((), jnp.int32)

@@ -208,4 +208,14 @@ class EigenRefreshCadence:
         tel.set_gauge("kfac/eigh_chunks", k_eff)
         tel.set_gauge("kfac/eigen_chunk_phase", -1 if chunk is None else chunk)
         tel.set_gauge("kfac/eigen_basis_age_steps", age)
+        # Curvature-solver configuration (static per run, but emitted with
+        # the cadence gauges so dashboards can segment refresh-latency series
+        # by solver without a config side channel).
+        tel.set_gauge(
+            "kfac/solver",
+            1 if getattr(self.kfac, "solver", "eigh") == "rsvd" else 0,
+        )
+        tel.set_gauge(
+            "kfac/solver_rank", getattr(self.kfac, "solver_rank", 0)
+        )
         return flags

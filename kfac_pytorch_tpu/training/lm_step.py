@@ -216,6 +216,9 @@ def make_lm_train_step(
         metrics = {"loss": loss, "ppl": jnp.exp(loss)}
         if kfac is not None and kfac.track_diagnostics:
             metrics.update(diagnostic_metrics(kfac_state["diagnostics"]))
+        if kfac_state is not None and "spectrum_mass" in kfac_state:
+            # randomized solver only — see training/step.py
+            metrics["kfac_spectrum_mass"] = kfac_state["spectrum_mass"]
         new_state = TrainState(
             step=state.step + 1,
             params=params,

@@ -493,6 +493,11 @@ def make_train_step(
         metrics = {"loss": loss, "accuracy": acc}
         if kfac is not None and kfac.track_diagnostics:
             metrics.update(diagnostic_metrics(kfac_state["diagnostics"]))
+        if kfac_state is not None and "spectrum_mass" in kfac_state:
+            # randomized solver only: fraction of factor trace the truncated
+            # eigenbases captured at the last refresh (→ the trainer's
+            # kfac/spectrum_mass_captured gauge)
+            metrics["kfac_spectrum_mass"] = kfac_state["spectrum_mass"]
         new_state = TrainState(
             step=state.step + 1,
             params=params,

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Pin the randomized solver's matmul-only guarantee in compiled HLO.
+
+``KFAC(solver="rsvd")`` replaces the full eigendecomposition of every factor
+side at/above ``solver_auto_threshold`` with a randomized truncated
+eigensolve (ops/rsvd.py) whose only eigendecompositions are the tiny
+``(r+p)×(r+p)`` Gram/Rayleigh–Ritz solves. This check compiles the refresh
+step twice — dense solver and randomized solver — and scans the HLO for
+eigendecomposition custom-calls operating on square dims at/above the
+threshold: the dense program must contain at least one (detector sanity —
+if the backend renames its eigh target this fails loudly instead of
+vacuously passing), the randomized program must contain NONE.
+
+Exit 0 with an "OK" line, 1 with a report. Run from the repo root
+(tier-1 wraps it in a test, tests/test_scripts.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kfac_pytorch_tpu import KFAC  # noqa: E402
+
+# Factor sides: 300/301 cross the 256 threshold (truncated), the 10-wide
+# head G stays dense — the rsvd program must keep ONLY sub-threshold eighs.
+_SIZES = [300, 300, 10]
+_THRESHOLD = 256
+_RANK = 64
+
+# eigendecomposition custom-call targets across the backends this repo
+# meets: LAPACK syevd on CPU (lapack_ssyevd / lapack_ssyevd_ffi), the
+# Eigh/qdwh decompositions elsewhere
+_EIGH_TARGET = re.compile(r"custom_call_target=\"[^\"]*(?:syevd|[Ee]igh|qdwh)")
+_SHAPE = re.compile(r"\[(\d+(?:,\d+)*)\]")
+
+
+def _big_eigh_calls(hlo: str, threshold: int) -> list:
+    """Eigh-flavored custom-call lines whose operand/result shapes include a
+    square trailing-two-dims matrix of size >= threshold."""
+    hits = []
+    for line in hlo.splitlines():
+        if "custom-call" not in line or not _EIGH_TARGET.search(line):
+            continue
+        for m in _SHAPE.finditer(line):
+            dims = [int(d) for d in m.group(1).split(",")]
+            if len(dims) >= 2 and dims[-1] == dims[-2] and dims[-1] >= threshold:
+                hits.append((dims[-1], line.strip()[:140]))
+                break
+    return hits
+
+
+def _refresh_hlo(**solver_kwargs) -> str:
+    r = np.random.RandomState(0)
+    params, grads, a_c, g_s = {}, {}, {}, {}
+    cin = _SIZES[0]
+    names = []
+    for i, cout in enumerate(_SIZES):
+        n = f"l{i}"
+        names.append(n)
+        params[n] = {
+            "kernel": jnp.asarray(r.randn(cin, cout) * 0.05, jnp.float32),
+            "bias": jnp.zeros((cout,), jnp.float32),
+        }
+        grads[n] = {
+            "kernel": jnp.asarray(r.randn(cin, cout), jnp.float32),
+            "bias": jnp.asarray(r.randn(cout), jnp.float32),
+        }
+        x = np.concatenate([r.randn(8, cin), np.ones((8, 1))], axis=1)
+        g = r.randn(8, cout)
+        a_c[n] = jnp.asarray(x.T @ x / 8, jnp.float32)
+        g_s[n] = jnp.asarray(g.T @ g / 8, jnp.float32)
+        cin = cout
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                layers=names, **solver_kwargs)
+    state = kfac.init(params)
+    fn = functools.partial(kfac.update, update_factors=True, update_eigen=True)
+    lowered = jax.jit(fn).lower(
+        grads, state, a_contribs=a_c, g_factor_stats=g_s,
+        lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+    )
+    return lowered.compile().as_text()
+
+
+def main() -> int:
+    dense_hits = _big_eigh_calls(_refresh_hlo(), _THRESHOLD)
+    rsvd_hits = _big_eigh_calls(
+        _refresh_hlo(solver="rsvd", solver_rank=_RANK,
+                     solver_auto_threshold=_THRESHOLD),
+        _THRESHOLD,
+    )
+    if not dense_hits:
+        print(
+            "check_solver_hlo: FAIL — the DENSE refresh program shows no "
+            f"eigh custom-call at square dim >= {_THRESHOLD}; the detector "
+            "no longer recognizes this backend's eigh target and the rsvd "
+            "assertion below would pass vacuously", file=sys.stderr,
+        )
+        return 1
+    if rsvd_hits:
+        print(
+            f"check_solver_hlo: FAIL — solver='rsvd' refresh still contains "
+            f"{len(rsvd_hits)} eigendecomposition custom-call(s) at square "
+            f"dim >= {_THRESHOLD}:", file=sys.stderr,
+        )
+        for dim, line in rsvd_hits[:5]:
+            print(f"  [{dim}x{dim}] {line}", file=sys.stderr)
+        return 1
+    print(
+        f"check_solver_hlo: OK — dense refresh has {len(dense_hits)} "
+        f"eigh custom-call(s) at dim >= {_THRESHOLD} "
+        f"(largest {max(d for d, _ in dense_hits)}); rsvd refresh has zero "
+        "(only sub-threshold Gram/Rayleigh–Ritz solves remain)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,34 @@ def test_collective_count_check():
     assert "OK" in res.stdout
 
 
+def test_solver_hlo_check():
+    """The solver='rsvd' refresh program must contain zero eigendecomposition
+    custom-calls at/above the truncation threshold — a dense eigh sneaking
+    back in means the matmul-only guarantee regressed
+    (scripts/check_solver_hlo.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_solver_hlo.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
+def test_no_bytecode_artifacts_tracked():
+    """git must never track __pycache__ directories or .pyc files — stale
+    bytecode shadows source edits and bloats the repo."""
+    res = subprocess.run(
+        ["git", "ls-files"], capture_output=True, text=True, cwd=REPO,
+    )
+    if res.returncode != 0:
+        pytest.skip("not a git checkout")
+    bad = [
+        f for f in res.stdout.splitlines()
+        if "__pycache__" in f or f.endswith(".pyc")
+    ]
+    assert not bad, f"bytecode artifacts tracked by git: {bad}"
+
+
 def test_bench_cpu_fallback_emits_json():
     """bench.py must emit parseable, schema-complete JSON with rc=0 even
     when the TPU backend never comes up: the probe subprocess (stubbed here
