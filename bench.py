@@ -389,11 +389,15 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
     kfac_kwargs = dict(kfac_kwargs or {})
     rec = rec if rec is not None else {}
     rec.update(tag=tag or "f32", batch=batch)
-    # factor-comm arms need the KFAC mesh: the plane shapes a cross-replica
-    # exchange, and make_train_step routes through the explicit-collective
-    # wrapper off kfac.mesh. On a single device the plane is inert and the
-    # arm degrades to a plain measurement (recorded as such).
-    comm_arm = any(k.startswith("factor_comm") for k in kfac_kwargs)
+    # factor-comm and owner-sharding arms need the KFAC mesh: both shape a
+    # cross-replica exchange, and make_train_step routes through the
+    # explicit-collective wrapper off kfac.mesh. On a single device the
+    # plane is inert (owner mode degrades to replicated with a warning) and
+    # the arm falls back to a plain measurement (recorded as such).
+    comm_arm = any(
+        k.startswith("factor_comm") or k == "factor_sharding"
+        for k in kfac_kwargs
+    )
     if comm_arm and jax.device_count() > 1:
         from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
 
@@ -415,13 +419,24 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         # arm needs its own buffers
         p = jax.tree_util.tree_map(jnp.copy, params)
         bs = jax.tree_util.tree_map(jnp.copy, batch_stats)
-        return TrainState(
+        st = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=p,
             batch_stats=bs,
             opt_state=tx.init(p),
             kfac_state=kfac.init(p) if kfac else None,
         )
+        if kfac is not None and getattr(kfac, "owner_sharded", False):
+            # owner mode's contract: curvature shards on their owners, the
+            # rest replicated — pre-placing keeps resharding noise out of
+            # the timed program (init() already placed kfac_state)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kst = st.kfac_state
+            st = st.replace(kfac_state=None)
+            st = jax.device_put(st, NamedSharding(kfac.mesh, P()))
+            st = st.replace(kfac_state=kst)
+        return st
 
     lr, damping = jnp.float32(0.1), jnp.float32(0.001)
     sgd_step = make_train_step(model, tx, None, train_kwargs={"train": True})
@@ -574,7 +589,20 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         for key in ("eigen", "eigen_stacked")
         for leaf in jax.tree_util.tree_leaves(s_kfac.kfac_state.get(key, {}))
     )
+    # Per-replica curvature-state footprint (factors + eigen tables, local
+    # to ONE device): the owner-sharding headline. Replicated keys count in
+    # full; owner-shard stacks count nbytes/world — each device holds one
+    # row-slice of the P(axis)-sharded stack (shard_plan_bytes' model).
+    world = kfac.mesh.devices.size if getattr(kfac, "mesh", None) else 1
+    sharded_keys = ("factor_shard", "eigen_shard", "eigen_pending_shard")
+    factor_state_bytes_local = sum(
+        leaf.nbytes // (world if key in sharded_keys else 1)
+        for key in ("factors", "eigen", "eigen_stacked") + sharded_keys
+        for leaf in jax.tree_util.tree_leaves(s_kfac.kfac_state.get(key, {}))
+    )
     rec.update(
+        factor_sharding=getattr(kfac, "factor_sharding", "replicated"),
+        factor_state_bytes_local=int(factor_state_bytes_local),
         solver=getattr(kfac, "solver", "eigh"),
         solver_rank=(
             kfac.solver_rank if getattr(kfac, "solver", "eigh") == "rsvd"
@@ -880,6 +908,15 @@ def main():
         # factor wire bytes/collectives from the plane's trace-time gauges
         ("factor_comm", "-comm", batch, None,
          dict(factor_comm_dtype="bf16", factor_comm_freq=fac_freq), True),
+        # -shard: owner-sharded factor state (DP-KFAC) composed with the
+        # bf16 wire and the pipelined refresh — curvature memory and factor
+        # wire both scale O(model/devices); read factor_state_bytes_local
+        # against the f32 arm's replicated footprint, and the wire is a
+        # reduce-scatter of the same bucketed payload plus ONE allgather of
+        # preconditioned grads (scripts/check_collective_count.py pins it)
+        ("owner_shard", "-shard", batch, None,
+         dict(factor_sharding="owner", factor_comm_dtype="bf16",
+              eigh_chunks=4), True),
         # -rsvd: the randomized low-rank curvature solver — compare its
         # refresh_ms_p50/p95 and eigen_table_bytes against the f32 arm's
         # (dense eigh, square Q tables) at identical numerics elsewhere

@@ -157,6 +157,14 @@ def parse_args(argv=None):
                         "instead of every one (merged running averages, "
                         "always flushed before an eigen refresh); 1 = "
                         "per-step exchange, exact")
+    p.add_argument("--factor-sharding", default="replicated",
+                   choices=["replicated", "owner"],
+                   help="owner: DP-KFAC owner-sharded curvature — factor "
+                        "stats reduce-scatter onto each layer's eigen-owner, "
+                        "eigen bases live only there, and ONE allgather "
+                        "replicates the preconditioned grads; factor+eigen "
+                        "memory and wire scale O(model/devices) "
+                        "(docs/PERF.md); replicated = exact prior behavior")
     p.add_argument("--precond-method", default="eigen",
                    choices=["eigen", "inverse"],
                    help="eigen: reference-parity eigenbasis solve (damping "
@@ -293,6 +301,7 @@ def main(argv=None):
             solver=args.solver,
             solver_rank=args.solver_rank,
             solver_auto_threshold=args.solver_auto_threshold,
+            factor_sharding=args.factor_sharding,
         )
         kfac_sched = KFACParamScheduler(
             kfac,
@@ -331,8 +340,17 @@ def main(argv=None):
         if resume_from_epoch and launch.is_primary():
             print(f"resumed from epoch {resume_from_epoch - 1}")
 
-    # replicate state over the mesh; batches are sharded on the data axis
-    state = jax.device_put(state, NamedSharding(mesh, P()))
+    # replicate state over the mesh; batches are sharded on the data axis.
+    # Owner-sharded curvature is placed per its own contract instead —
+    # factor/eigen shards land on their owners (a freshly restored
+    # checkpoint is re-homed the same way, ckpt.rehome_kfac_state)
+    if kfac is not None and kfac.owner_sharded:
+        kstate = ckpt.rehome_kfac_state(kfac, state.kfac_state)
+        state = state.replace(kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(kfac_state=kstate)
+    else:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
 
     train_step = make_train_step(
         model, tx, kfac, label_smoothing=args.label_smoothing,

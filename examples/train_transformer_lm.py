@@ -117,6 +117,15 @@ def parse_args(argv=None):
                    help="allreduce factor statistics every N capture steps "
                         "(merged running averages, always flushed before an "
                         "eigen refresh); pure-DP only; 1 = per-step, exact")
+    p.add_argument("--factor-sharding", default="replicated",
+                   choices=["replicated", "owner"],
+                   help="owner: DP-KFAC owner-sharded curvature — factor "
+                        "stats reduce-scatter onto each layer's eigen-owner "
+                        "and ONE allgather replicates the preconditioned "
+                        "grads; O(model/devices) factor memory and wire "
+                        "(docs/PERF.md); pure-DP only (--seq-parallel 1), "
+                        "incompatible with --kfac-embedding (diagonal-A "
+                        "factors have no dense matrix to shard)")
     p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
                    help="curvature eigensolver: eigh = full (dense) "
                         "eigendecomposition, rsvd = randomized truncated "
@@ -156,7 +165,24 @@ def main(argv=None):
         raise SystemExit(f"--seq-parallel {sp} must divide device count {devices.size}")
     if args.seq_len % sp != 0:
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
-    mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
+    owner = args.factor_sharding == "owner"
+    if owner and sp > 1:
+        raise SystemExit(
+            "--factor-sharding owner requires a pure data-parallel mesh "
+            "(--seq-parallel 1): factor shards and the preconditioned-grad "
+            "allgather are laid out over a single mesh axis"
+        )
+    if owner and args.kfac_embedding:
+        raise SystemExit(
+            "--factor-sharding owner does not support --kfac-embedding: "
+            "the embedding's diagonal A factor has no dense matrix to shard"
+        )
+    # owner sharding lays factor/eigen shards over ONE mesh axis, so its
+    # mesh drops the (size-1) seq axis; the default mesh is unchanged
+    mesh = (
+        Mesh(devices, ("data",)) if owner
+        else Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
+    )
     dp = devices.size // sp
     n_proc = launch.size()
     if dp % n_proc != 0:
@@ -222,6 +248,7 @@ def main(argv=None):
             solver=args.solver,
             solver_rank=args.solver_rank,
             solver_auto_threshold=args.solver_auto_threshold,
+            factor_sharding=args.factor_sharding,
         )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
@@ -240,7 +267,15 @@ def main(argv=None):
     if args.checkpoint_dir:
         state, resume_from_epoch = ckpt.auto_resume(args.checkpoint_dir, state)
         resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
-    state = jax.device_put(state, NamedSharding(mesh, P()))
+    if kfac is not None and kfac.owner_sharded:
+        # owner-mode placement contract: factor/eigen shards on their
+        # owners (re-homing a restored checkpoint), the rest replicated
+        kstate = ckpt.rehome_kfac_state(kfac, state.kfac_state)
+        state = state.replace(kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(kfac_state=kstate)
+    else:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
 
     if args.grad_comm_dtype and sp > 1:
         raise SystemExit(
@@ -260,7 +295,8 @@ def main(argv=None):
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_fn = make_eval_step(model, eval_kwargs={"train": False})
-    batch_spec = P("data", "seq")
+    # the owner-mode mesh has no seq axis (it is pure-DP by construction)
+    batch_spec = P("data") if len(mesh.axis_names) == 1 else P("data", "seq")
 
     # [B_total, N] contiguous streams; segments of seq_len become samples.
     # Multi-host: every process derives the same global stream, then keeps

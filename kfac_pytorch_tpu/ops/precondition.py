@@ -552,6 +552,187 @@ def precondition_all_distributed(
     )
 
 
+def _owner_gather_layout(
+    shapes: Dict[str, Tuple[int, int]],
+    owners: Dict[str, int],
+    world: int,
+    rank_fn,
+) -> Tuple[list, Dict[str, Dict[str, Any]], int]:
+    """Static allgather-buffer layout for the owner-sharded solve.
+
+    Per layer, pick the cheaper wire payload (DP-KFAC §IV): the
+    preconditioned ``[g, a]`` update, or — when the randomized solver
+    truncates a side and the compact Q/d/ρ tables are smaller — the tables
+    themselves, re-solved replicated after the gather. Returns
+    ``(order, segments, per_device_elems)`` where ``order`` is
+    :func:`precondition_all`'s canonical emission order (KL-clip summation
+    order), ``segments[name]`` carries the mode, the owner-buffer offset and
+    the table field layout, and ``per_device_elems`` is the uniform f32
+    buffer width (max owned payload over devices).
+    """
+    order = [n for names in shape_groups(shapes).values() for n in names]
+    segments: Dict[str, Dict[str, Any]] = {}
+    cursor = [0] * world
+    for name in order:
+        g, a = int(shapes[name][0]), int(shapes[name][1])
+        ra = rank_fn(a) if rank_fn is not None else None
+        rg = rank_fn(g) if rank_fn is not None else None
+        fields = [
+            ("QA", (a, ra) if ra is not None else (a, a)),
+            ("dA", (ra,) if ra is not None else (a,)),
+        ]
+        if ra is not None:
+            fields.append(("rhoA", ()))
+        fields += [
+            ("QG", (g, rg) if rg is not None else (g, g)),
+            ("dG", (rg,) if rg is not None else (g,)),
+        ]
+        if rg is not None:
+            fields.append(("rhoG", ()))
+        def _elems(shape: Tuple[int, ...]) -> int:
+            size = 1
+            for d in shape:
+                size *= int(d)
+            return size
+
+        table_elems = sum(_elems(s) for _, s in fields)
+        update_elems = g * a
+        mode = (
+            "tables"
+            if (ra is not None or rg is not None) and table_elems < update_elems
+            else "update"
+        )
+        elems = table_elems if mode == "tables" else update_elems
+        owner = owners[name]
+        segments[name] = {
+            "mode": mode,
+            "offset": cursor[owner],
+            "elems": elems,
+            "fields": tuple(fields),
+        }
+        cursor[owner] += elems
+    return order, segments, max(1, max(cursor))
+
+
+def precondition_all_owner(
+    grad_mats: Dict[str, jnp.ndarray],
+    eigen_shard: Dict[str, Dict[str, jnp.ndarray]],
+    damping: jnp.ndarray,
+    precision: lax.Precision = _ROTATION_PRECISION,
+    *,
+    mesh: Mesh,
+    plan,
+    rank_fn=None,
+    eigen_dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    """Owner-sharded preconditioning: solve on the owner, allgather results.
+
+    The ``factor_sharding="owner"`` hot path (DP-KFAC, arxiv 2206.15143):
+    each layer's eigenbasis lives ONLY in its owner's shard rows, so the
+    owner runs :func:`solve_eigen_entry` against its local shard (a
+    ``lax.cond`` on the flat device index — non-owners skip the matmuls and
+    the shard HBM reads), packs the flat result into its slice of a uniform
+    per-device buffer, and ONE ``lax.all_gather`` replicates every layer's
+    payload (pinned by ``scripts/check_collective_count.py``). Layers whose
+    compact rsvd tables beat the dense update on the wire ship Q/d/ρ instead
+    and re-solve replicated after the gather (:func:`_owner_gather_layout`).
+    Updates come back in :func:`precondition_all`'s emission order so the
+    KL-clip summation reassociates identically.
+    """
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+    axes = tuple(mesh.axis_names)
+    if len(axes) != 1:
+        raise ValueError(
+            "owner-sharded preconditioning requires a pure data-parallel "
+            f"mesh; got axes {axes}"
+        )
+    axis = axes[0]
+    shapes = {n: (g.shape[0], g.shape[1]) for n, g in grad_mats.items()}
+    order, segments, width = _owner_gather_layout(
+        shapes, plan.owners, plan.world, rank_fn
+    )
+    get_telemetry().set_gauge(
+        "kfac/precond_allgather_bytes", plan.world * width * 4
+    )
+
+    def _entry(eshard, name):
+        g_n, a_n = shapes[name]
+        out = {}
+        for fac, n in (("A", a_n), ("G", g_n)):
+            slot = plan.slot(name, fac)
+            grp = eshard[f"n{n}"]
+            out[f"Q{fac}"] = grp["Q"][slot.row]
+            out[f"d{fac}"] = grp["d"][slot.row]
+            if "rho" in grp:
+                out[f"rho{fac}"] = grp["rho"][slot.row]
+        return out
+
+    eigen_specs = jax.tree_util.tree_map(lambda _: P(axis), eigen_shard)
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=(P(), eigen_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def _inner(gmats, eshard, damp):
+        dev = lax.axis_index(axis)
+        buf = jnp.zeros((width,), jnp.float32)
+        for name in order:
+            seg = segments[name]
+
+            def _payload(name=name, seg=seg):
+                entry = _entry(eshard, name)
+                if seg["mode"] == "update":
+                    v = solve_eigen_entry(gmats[name], entry, damp, precision)
+                    return v.astype(jnp.float32).reshape(-1)
+                parts = [
+                    entry[k].astype(jnp.float32).reshape(-1)
+                    for k, _ in seg["fields"]
+                ]
+                return jnp.concatenate(parts)
+
+            off, elems = seg["offset"], seg["elems"]
+            buf = lax.cond(
+                dev == plan.owners[name],
+                lambda b, _p=_payload, off=off, elems=elems: b.at[
+                    off : off + elems
+                ].set(_p()),
+                lambda b: b,
+                buf,
+            )
+        # the single preconditioned-gradient allgather of the owner mode
+        return lax.all_gather(buf, axis)  # [world, width], replicated
+
+    gathered = _inner(grad_mats, eigen_shard, damping)
+
+    out: Dict[str, jnp.ndarray] = {}
+    for name in order:
+        seg = segments[name]
+        g_n, a_n = shapes[name]
+        payload = gathered[plan.owners[name], seg["offset"] : seg["offset"] + seg["elems"]]
+        if seg["mode"] == "update":
+            out[name] = payload.reshape(g_n, a_n)
+            continue
+        entry = {}
+        off = 0
+        for k, shp in seg["fields"]:
+            size = 1
+            for d in shp:
+                size *= int(d)
+            val = payload[off : off + size].reshape(shp)
+            off += size
+            if k.startswith("Q"):
+                # round-trip through the storage dtype so the replicated
+                # re-solve sees the exact bits the owner's shard holds
+                val = val.astype(eigen_dtype)
+            entry[k] = val
+        out[name] = solve_eigen_entry(grad_mats[name], entry, damping, precision)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Inverse-method preconditioning (precond_method="inverse")
 #

@@ -226,6 +226,199 @@ class FactorBucket:
     size: int
 
 
+def plan_factor_shards(
+    shapes: Dict[str, Tuple[int, int]],
+    world: int,
+    max_bucket_elems: int = 1 << 20,
+) -> "FactorShardPlan":
+    """Plan the owner-sharded factor-state layout (DP-KFAC, arxiv 2206.15143).
+
+    Ownership is the LPT table from :func:`precondition_assignment` — the
+    device that rotates a layer's gradient every step is the device that
+    keeps its running averages and eigenbases, so the owner-local solve
+    never moves a factor. Both factors of a layer land on the layer's owner
+    (the solve needs A and G together).
+
+    Storage layout: slots group by EXACT side size ``n`` (not eigh bucket
+    size — padding every 7-wide bias factor to 128² would forfeit the
+    O(model/devices) memory claim) into ``[world·rows_n, n, n]`` stacks
+    sharded on the leading axis, where ``rows_n`` is the *maximum* number of
+    size-``n`` slots any one device owns — the stack must be device-uniform
+    for pjit, so lighter devices carry pad rows (zero-fed by the scatter,
+    decayed by the EMA, never read by the solve). Row assignment walks
+    layers in sorted-name order, A then G, so every host derives the same
+    table.
+
+    Wire layout: each size group's per-device payload (``rows_n·n²``
+    elements) becomes one pseudo-leaf fed to :func:`plan_factor_buckets`,
+    so the reduce-scatter fuses groups into the same ~1 Mi-element buckets
+    the replicated allreduce plane uses — one collective per bucket, and
+    ``FactorBucketEntry.index`` indexes :attr:`FactorShardPlan.group_sizes`.
+    """
+    owners = precondition_assignment(shapes, world)
+    slots: List[FactorShardSlot] = []
+    counts: Dict[Tuple[int, int], int] = {}  # (size, owner) -> next row
+    for name in sorted(shapes):
+        g, a = shapes[name]
+        for factor, size in (("A", int(a)), ("G", int(g))):
+            owner = owners[name]
+            row = counts.get((size, owner), 0)
+            counts[(size, owner)] = row + 1
+            slots.append(
+                FactorShardSlot(
+                    name=name, factor=factor, size=size, owner=owner, row=row
+                )
+            )
+    group_rows = {
+        size: max(c for (s, _), c in counts.items() if s == size)
+        for size in {s.size for s in slots}
+    }
+    sizes = tuple(sorted(group_rows))
+    wire_buckets = plan_factor_buckets(
+        [(group_rows[n] * n * n,) for n in sizes], max_bucket_elems
+    )
+    return FactorShardPlan(
+        world=world,
+        owners=owners,
+        slots=tuple(slots),
+        group_rows=group_rows,
+        group_sizes=sizes,
+        wire_buckets=wire_buckets,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorShardSlot:
+    """One (layer, factor) matrix's home in the owner-sharded state.
+
+    ``row`` is the slot's LOCAL row inside its owner's ``[rows_n, n, n]``
+    shard of the size-``n`` group; the global row in the ``[world·rows_n,
+    n, n]`` stack is ``owner·rows_n + row``.
+    """
+
+    name: str
+    factor: str  # "A" | "G"
+    size: int
+    owner: int
+    row: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorShardPlan:
+    """Static owner-sharded layout: who holds what, and the wire buckets."""
+
+    world: int
+    owners: Dict[str, int]
+    slots: Tuple[FactorShardSlot, ...]
+    group_rows: Dict[int, int]
+    group_sizes: Tuple[int, ...]
+    wire_buckets: Tuple["FactorBucket", ...]
+
+    def slot(self, name: str, factor: str) -> FactorShardSlot:
+        for s in self.slots:
+            if s.name == name and s.factor == factor:
+                return s
+        raise KeyError((name, factor))
+
+    def group_slots(self, size: int) -> Tuple[FactorShardSlot, ...]:
+        return tuple(s for s in self.slots if s.size == size)
+
+    def valid_rows(self, size: int) -> List[List[bool]]:
+        """``[world][rows]`` mask: True where a real slot lives (pad rows of
+        under-loaded devices are False — excluded from spectrum-mass sums)."""
+        rows = self.group_rows[size]
+        mask = [[False] * rows for _ in range(self.world)]
+        for s in self.group_slots(size):
+            mask[s.owner][s.row] = True
+        return mask
+
+    def owner_count(self) -> int:
+        return len({s.owner for s in self.slots})
+
+
+def shard_plan_bytes(
+    plan: FactorShardPlan,
+    rank_fn: Optional[Callable[[int], Optional[int]]] = None,
+    eigen_itemsize: int = 4,
+) -> Dict[str, object]:
+    """Planned byte totals of the owner-sharded layout, in one place.
+
+    Shared by the comm plane's gauges and the bench reporter so the two
+    cannot drift. ``buffer_local`` keys are what ONE device actually
+    allocates (padded, device-uniform stacks: factor f32, eigen Q at
+    ``eigen_itemsize`` + f32 eigenvalues + f32 rho for truncated groups);
+    ``per_owner`` is each device's un-padded owned payload —
+    the load-balance view. ``replicated_total`` is what every replica holds
+    today, for the O(model/devices) comparison.
+    """
+
+    def eigen_elems(n: int) -> Tuple[int, int, int]:
+        # (Q elems, d elems, rho count) for one size-n slot
+        rank = rank_fn(n) if rank_fn is not None else None
+        if rank is None:
+            return n * n, n, 0
+        return n * rank, rank, 1
+
+    factor_local = 0
+    eigen_local = 0
+    for n in plan.group_sizes:
+        rows = plan.group_rows[n]
+        q, d, rho = eigen_elems(n)
+        factor_local += rows * n * n * 4
+        eigen_local += rows * (q * eigen_itemsize + d * 4 + rho * 4)
+    per_owner = [0] * plan.world
+    replicated_total = 0
+    for s in plan.slots:
+        q, d, rho = eigen_elems(s.size)
+        slot_bytes = s.size * s.size * 4 + q * eigen_itemsize + d * 4 + rho * 4
+        per_owner[s.owner] += slot_bytes
+        replicated_total += slot_bytes
+    return {
+        "factor_buffer_local": factor_local,
+        "eigen_buffer_local": eigen_local,
+        "total_buffer_local": factor_local + eigen_local,
+        "per_owner": per_owner,
+        "replicated_total": replicated_total,
+        "owner_count": plan.owner_count(),
+        "wire_bucket_count": len(plan.wire_buckets),
+        "scatter_wire_bytes": sum(b.size for b in plan.wire_buckets)
+        * plan.world
+        * 4,
+    }
+
+
+def plan_owner_chunks(
+    plan: FactorShardPlan,
+    chunks: int,
+    granularity: int = 512,
+    minimum: int = 128,
+    rank_fn: Optional[Callable[[int], Optional[int]]] = None,
+) -> List[List[Tuple[int, int]]]:
+    """Partition the owner-local refresh into ``chunks`` static row-job sets.
+
+    A job is a ``(size, row)`` pair — the SAME row of every device's local
+    shard, because the chunked program must be SPMD-uniform: all devices
+    decompose row r of group n in the same chunk (pad rows compute garbage
+    that is never read, exactly like the monolithic owner refresh). LPT over
+    :func:`_slot_cost` with deterministic (cost, size, row) tie-breaks, so
+    the chunk id stays a static jit argument. Chunks may come back empty.
+    """
+    jobs = [
+        (n, r) for n in plan.group_sizes for r in range(plan.group_rows[n])
+    ]
+    cost = {
+        j: _slot_cost(j[0], granularity, minimum, rank_fn) for j in jobs
+    }
+    order = sorted(jobs, key=lambda j: (-cost[j], j[0], j[1]))
+    load = [0] * chunks
+    out: List[List[Tuple[int, int]]] = [[] for _ in range(chunks)]
+    for j in order:
+        c = min(range(chunks), key=lambda c: (load[c], c))
+        out[c].append(j)
+        load[c] += cost[j]
+    return [sorted(p) for p in out]
+
+
 def plan_factor_buckets(
     shapes: Sequence[Tuple[int, ...]], max_bucket_elems: int = 1 << 20
 ) -> Tuple[FactorBucket, ...]:

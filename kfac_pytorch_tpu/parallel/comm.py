@@ -120,6 +120,7 @@ class FactorComm:
         comm_dtype: Any = jnp.float32,
         comm_freq: int = 1,
         max_bucket_elems: int = 1 << 20,
+        sharded: bool = False,
     ):
         if int(comm_freq) < 1:
             raise ValueError(f"Invalid factor_comm_freq: {comm_freq}")
@@ -128,6 +129,7 @@ class FactorComm:
         self.comm_dtype = np.dtype(comm_dtype)
         self.comm_freq = int(comm_freq)
         self.max_bucket_elems = int(max_bucket_elems)
+        self.sharded = bool(sharded)
         self.last_wire_bytes: Optional[int] = None
         self.last_collectives: Optional[int] = None
         self._plans: Dict[Any, Tuple[FactorBucket, ...]] = {}
@@ -148,8 +150,13 @@ class FactorComm:
     def active(self) -> bool:
         """True when the plane changes the wire vs. the defaults — the train
         steps then route the capture computation through the explicit-
-        collective wrapper even without ``grad_comm_dtype``."""
-        return self.multi_device and (self.defer or self.comm_dtype != _F32)
+        collective wrapper even without ``grad_comm_dtype``. Owner-sharded
+        mode (``factor_sharding="owner"``) is always active: statistics must
+        stay local at capture so the reduce-scatter can land each layer's
+        mean only on its owner."""
+        return self.multi_device and (
+            self.defer or self.comm_dtype != _F32 or self.sharded
+        )
 
     # -- plan -----------------------------------------------------------
 
@@ -200,9 +207,11 @@ class FactorComm:
         Fuses the A and G dicts into one stat tree so both factors share
         buckets. Deferred mode returns the LOCAL statistics unchanged —
         each replica's running averages then evolve independently until
-        :meth:`flush` merges them.
+        :meth:`flush` merges them. Owner-sharded mode also returns locals:
+        the reduce-scatter in :meth:`scatter_merge` is the exchange, and it
+        runs from ``KFAC.update`` where the factor shards are in scope.
         """
-        if self.defer:
+        if self.defer or self.sharded:
             return a_contribs, g_stats
         tree = capture.factor_stat_tree(a_contribs, g_stats)
         tree = self.allreduce(tree, axis_name)
@@ -231,3 +240,94 @@ class FactorComm:
             check_vma=False,
         )(lambda tree: self.allreduce(tree, self.axis_name))
         return fn(facs)
+
+    def scatter_merge(
+        self,
+        payload: Dict[str, Dict[str, jnp.ndarray]],
+        shard: Dict[str, jnp.ndarray],
+        plan,
+        decay: jnp.ndarray,
+    ) -> Dict[str, jnp.ndarray]:
+        """Reduce-scatter per-replica statistics onto the factor shards.
+
+        The owner-sharded replacement for the bucketed allreduce: each
+        layer's merged statistic lands ONLY on its eigen-owner's shard row,
+        so the wire and the master-EMA memory are both O(model/devices)
+        (DP-KFAC, arxiv 2206.15143). ``payload`` is the per-replica local
+        statistic tree — ``(1−α)·contribʳ`` for the every-step cadence, or
+        the deferred local accumulator at a flush — physically diverged
+        across devices; ``shard`` is the ``{"n<size>": [world·rows, n, n]}``
+        sharded stack from the KFAC state. The merge is
+
+            shardₙₑw = decay ⊙ shard + mean_r(payload_r)   (owner rows)
+
+        with ``decay`` the traced EMA carry weight (``α``, or ``α^m`` after
+        ``m`` deferred capture steps — exact vs. the replicated path by EMA
+        linearity). Pad rows of under-loaded devices receive a zero payload
+        and just decay; they are never read. Buckets follow
+        ``plan.wire_buckets`` (one reduce-scatter per bucket, pinned by
+        ``scripts/check_collective_count.py``) and the optional wire
+        downcast applies to the bucket payload only, like :meth:`allreduce`.
+        """
+        axis = self.axis_name
+        world = plan.world
+        wire_dtype = None if self.comm_dtype == _F32 else self.comm_dtype
+        wire = (
+            sum(b.size for b in plan.wire_buckets)
+            * world
+            * self.comm_dtype.itemsize
+        )
+        tel = get_telemetry()
+        tel.set_gauge("kfac/factor_wire_bytes", wire)
+        tel.set_gauge("kfac/factor_collectives", len(plan.wire_buckets))
+        self.last_wire_bytes = wire
+        self.last_collectives = len(plan.wire_buckets)
+
+        def _body(payload, shard, decay):
+            groups: Dict[int, jnp.ndarray] = {}
+            for n in plan.group_sizes:
+                rows = plan.group_rows[n]
+                flat = jnp.zeros((world * rows, n * n), jnp.float32)
+                for s in plan.group_slots(n):
+                    leaf = payload[s.name][s.factor].astype(jnp.float32)
+                    flat = flat.at[s.owner * rows + s.row].set(
+                        leaf.reshape(-1)
+                    )
+                groups[n] = flat.reshape(world, rows * n * n)
+            new_shard = dict(shard)
+            with get_telemetry().span("trace/kfac/factor_comm"):
+                for bucket in plan.wire_buckets:
+                    parts = [
+                        groups[plan.group_sizes[e.index]]
+                        for e in bucket.entries
+                    ]
+                    buf = (
+                        parts[0]
+                        if len(parts) == 1
+                        else jnp.concatenate(parts, axis=1)
+                    )
+                    if wire_dtype is not None:
+                        buf = buf.astype(wire_dtype)
+                    red = lax.psum_scatter(
+                        buf, axis, scatter_dimension=0, tiled=True
+                    )
+                    red = red[0].astype(jnp.float32) / world
+                    for e in bucket.entries:
+                        n = plan.group_sizes[e.index]
+                        rows = plan.group_rows[n]
+                        seg = red[e.offset : e.offset + e.size]
+                        key = f"n{n}"
+                        new_shard[key] = decay * shard[key] + seg.reshape(
+                            rows, n, n
+                        )
+            return new_shard
+
+        shard_specs = {k: P(self.axis_name) for k in shard}
+        fn = partial(
+            compat.shard_map,
+            mesh=self.mesh,
+            in_specs=(P(), shard_specs, P()),
+            out_specs=shard_specs,
+            check_vma=False,
+        )(_body)
+        return fn(payload, shard, decay)

@@ -541,6 +541,222 @@ def _replicated_results(
     return results
 
 
+# ---------------------------------------------------------------------------
+# Owner-sharded refresh (factor_sharding="owner")
+# ---------------------------------------------------------------------------
+#
+# In owner-sharded mode there is nothing to exchange: each device's local
+# shard of the ``{"n<size>": [world·rows, n, n]}`` factor stacks already IS
+# exactly the slot set it owns, so the refresh is one shard_map whose per-
+# device program decomposes its local rows and writes its local eigen-shard
+# rows — zero collectives, O(model/devices) compute and memory. The padded
+# shape-bucket discipline is unchanged (same pad/unpad helpers as the
+# replicated paths, so per-matrix results match the replicated refresh);
+# pad rows of under-loaded devices decompose decayed garbage that no solve
+# ever reads.
+
+
+def _owner_group_solve(
+    local: jnp.ndarray,
+    n: int,
+    rank: Optional[int],
+    eps: float,
+    granularity: int,
+    minimum: int,
+    eigen_dtype,
+) -> Dict[str, jnp.ndarray]:
+    """Decompose one size-group's local ``[rows, n, n]`` shard stack.
+
+    Returns the group's eigen-shard entry: dense ``{"Q" [rows, n, n], "d"
+    [rows, n]}`` or truncated ``{"Q" [rows, n, r], "d" [rows, r], "rho"
+    [rows]}``, with Q stored at ``eigen_dtype`` exactly like the replicated
+    paths' whole-dict downcast.
+    """
+    m = bucket_size(n, granularity, minimum)
+    sym = symmetrize(local.astype(jnp.float32))
+    if rank is None:
+        stack = jax.vmap(lambda b: pad_for_eigh(b, m))(sym)
+        q, d = batched_eigh(stack)
+        q, d = jax.vmap(lambda qq, dd: unpad_eigh(qq, dd, n, eps))(q, d)
+        return {"Q": q.astype(eigen_dtype), "d": d}
+    stack = jax.vmap(lambda b: pad_for_rsvd(b, m))(sym)
+    q, d = batched_randomized_eigh(stack, rank, eps)
+    traces = jnp.trace(sym, axis1=-2, axis2=-1)
+    rho = jax.vmap(lambda t, dd: residual_rho(t, dd, n, rank))(traces, d)
+    return {"Q": q[:, :n, :].astype(eigen_dtype), "d": d, "rho": rho}
+
+
+def owner_eigen_update(
+    factor_shard: Dict[str, jnp.ndarray],
+    plan,
+    mesh: Mesh,
+    axis_name: str = "data",
+    eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
+    rank_fn=None,
+    eigen_dtype=jnp.float32,
+) -> Dict[str, jnp.ndarray]:
+    """Monolithic owner-local refresh of every factor shard row.
+
+    ``factor_shard`` is the sharded ``{"n<size>": [world·rows, n, n]}``
+    stack dict from the owner-mode KFAC state; returns the matching
+    ``{"n<size>": {"Q", "d"[, "rho"]}}`` eigen-shard dict, sharded the same
+    way. Purely owner-local — no collective appears in the program.
+    """
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name), factor_shard),),
+        out_specs=_owner_eigen_specs(plan, rank_fn, axis_name),
+        check_vma=False,
+    )
+    def _inner(shard):
+        tel = get_telemetry()
+        out = {}
+        for n in plan.group_sizes:
+            rank = rank_fn(n) if rank_fn is not None else None
+            with tel.span("trace/eigh/compute"):
+                out[f"n{n}"] = _owner_group_solve(
+                    shard[f"n{n}"], n, rank, eps, granularity, minimum,
+                    eigen_dtype,
+                )
+        return out
+
+    return _inner(factor_shard)
+
+
+def _owner_eigen_specs(plan, rank_fn, axis_name: str):
+    """Out-spec pytree matching the owner eigen-shard structure."""
+    specs = {}
+    for n in plan.group_sizes:
+        rank = rank_fn(n) if rank_fn is not None else None
+        entry = {"Q": P(axis_name), "d": P(axis_name)}
+        if rank is not None:
+            entry["rho"] = P(axis_name)
+        specs[f"n{n}"] = entry
+    return specs
+
+
+def owner_eigen_chunk_update(
+    factor_shard: Dict[str, jnp.ndarray],
+    pending_shard: Dict[str, Dict[str, jnp.ndarray]],
+    jobs: List[Tuple[int, int]],
+    plan,
+    mesh: Mesh,
+    axis_name: str = "data",
+    eps: float = 1e-10,
+    granularity: int = 512,
+    minimum: int = 128,
+    rank_fn=None,
+    eigen_dtype=jnp.float32,
+) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """One chunk of the pipelined owner-local refresh.
+
+    ``jobs`` is this chunk's static ``(size, row)`` list from
+    ``parallel.assignment.plan_owner_chunks`` — every device decomposes the
+    SAME local rows of the same groups (SPMD-uniform program) and overwrites
+    just those rows of its ``eigen_pending_shard``, the owner-mode analog of
+    :func:`_scatter_into`. Empty chunks return ``pending_shard`` unchanged.
+    """
+    if not jobs:
+        return pending_shard
+    by_group: Dict[int, List[int]] = {}
+    for n, r in jobs:
+        by_group.setdefault(n, []).append(r)
+
+    shard_specs = jax.tree_util.tree_map(lambda _: P(axis_name), factor_shard)
+    pending_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), pending_shard
+    )
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=(shard_specs, pending_specs),
+        out_specs=pending_specs,
+        check_vma=False,
+    )
+    def _inner(shard, pending):
+        tel = get_telemetry()
+        out = {k: dict(v) for k, v in pending.items()}
+        for n in sorted(by_group):
+            rows = jnp.asarray(sorted(by_group[n]), jnp.int32)
+            rank = rank_fn(n) if rank_fn is not None else None
+            with tel.span("trace/eigh/compute"):
+                sub = jnp.take(shard[f"n{n}"], rows, axis=0)
+                res = _owner_group_solve(
+                    sub, n, rank, eps, granularity, minimum, eigen_dtype
+                )
+            key = f"n{n}"
+            for field, val in res.items():
+                out[key][field] = out[key][field].at[rows].set(
+                    val.astype(out[key][field].dtype)
+                )
+        return out
+
+    return _inner(factor_shard, pending_shard)
+
+
+def owner_spectrum_mass(
+    factor_shard: Dict[str, jnp.ndarray],
+    eigen_shard: Dict[str, Dict[str, jnp.ndarray]],
+    plan,
+    mesh: Mesh,
+    axis_name: str = "data",
+    rank_fn=None,
+) -> jnp.ndarray:
+    """Captured-spectrum fraction over all truncated slots (owner mode).
+
+    The owner-sharded twin of the preconditioner's ``_spectrum_mass``: each
+    device sums its VALID rows' kept eigenvalue mass and factor traces (pad
+    rows masked by the plan's validity table), one psum pair merges the
+    partials, and the replicated scalar matches the replicated metric up to
+    summation order.
+    """
+    import numpy as np
+
+    valid = {
+        n: jnp.asarray(np.asarray(plan.valid_rows(n)), jnp.float32)
+        for n in plan.group_sizes
+        if rank_fn is not None and rank_fn(n) is not None
+    }
+    if not valid:
+        return jnp.float32(1.0)
+    axes = tuple(mesh.axis_names)
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(axis_name), factor_shard),
+            jax.tree_util.tree_map(lambda _: P(axis_name), eigen_shard),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def _inner(shard, eigen):
+        dev = lax.axis_index(axes[0])
+        for a in axes[1:]:
+            dev = dev * mesh.shape[a] + lax.axis_index(a)
+        cap = jnp.float32(0.0)
+        tot = jnp.float32(0.0)
+        for n, vtab in valid.items():
+            vmask = jnp.take(vtab, dev, axis=0)  # [rows]
+            d = eigen[f"n{n}"]["d"]  # [rows, r]
+            traces = jnp.trace(
+                shard[f"n{n}"].astype(jnp.float32), axis1=-2, axis2=-1
+            )
+            cap = cap + jnp.sum(d * vmask[:, None])
+            tot = tot + jnp.sum(traces * vmask)
+        cap = lax.psum(cap, axes)
+        tot = lax.psum(tot, axes)
+        return cap / jnp.maximum(tot, 1e-30)
+
+    return _inner(factor_shard, eigen_shard)
+
+
 def replicated_eigen_update(
     factors: Dict[str, Dict[str, jnp.ndarray]],
     diag_blocks_per_layer: Dict[str, int],

@@ -36,7 +36,9 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from kfac_pytorch_tpu import capture
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
@@ -46,11 +48,17 @@ from kfac_pytorch_tpu.ops import precondition as precond_ops
 from kfac_pytorch_tpu.parallel.assignment import (
     layer_assignment,
     plan_eigh_chunks,
+    plan_factor_shards,
+    plan_owner_chunks,
     precondition_assignment,
+    shard_plan_bytes,
 )
 from kfac_pytorch_tpu.parallel.comm import FactorComm
 from kfac_pytorch_tpu.parallel.sharded_eigh import (
     build_slots,
+    owner_eigen_chunk_update,
+    owner_eigen_update,
+    owner_spectrum_mass,
     replicated_eigen_chunk_update,
     replicated_eigen_update,
     sharded_eigen_chunk_update,
@@ -140,6 +148,7 @@ class KFAC:
         solver: str = "eigh",
         solver_rank: int = 128,
         solver_auto_threshold: int = 512,
+        factor_sharding: str = "replicated",
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -297,6 +306,65 @@ class KFAC:
         self.solver = solver
         self.solver_rank = int(solver_rank)
         self.solver_auto_threshold = int(solver_auto_threshold)
+        # Where the factor running averages / eigenbases LIVE on the mesh:
+        # "replicated" (default, bitwise-inert — every device holds every
+        # layer's curvature state, reference parity) or "owner" (DP-KFAC,
+        # arxiv 2206.15143: each layer's state lives only on its LPT
+        # precondition owner; factor statistics reduce-SCATTER onto the
+        # owner, the owner decomposes and solves locally, and one allgather
+        # moves just the preconditioned gradients — per-replica state and
+        # factor wire both become O(model/devices)). The shard layout is
+        # parallel.assignment.plan_factor_shards.
+        _validate(
+            "factor_sharding",
+            factor_sharding in ("replicated", "owner"),
+            factor_sharding,
+        )
+        if factor_sharding == "owner":
+            if precond_method != "eigen":
+                raise ValueError(
+                    "factor_sharding='owner' shards the eigenbasis state; "
+                    "precond_method='inverse' keeps explicit Cholesky "
+                    "inverses that this mode does not lay out — use the "
+                    "eigen method or replicated sharding"
+                )
+            if diag_blocks != 1:
+                raise ValueError(
+                    "factor_sharding='owner' stores one whole-factor slot "
+                    "per (layer, side); diag_blocks > 1 carves factors into "
+                    "blocks with their own owner table — pick one "
+                    "distribution scheme"
+                )
+            if distribute_precondition:
+                raise ValueError(
+                    "factor_sharding='owner' already preconditions each "
+                    "layer on its owner (that is where its eigenbasis "
+                    "lives); distribute_precondition=True would layer a "
+                    "second, different owner table on top — drop it"
+                )
+            if track_diagnostics:
+                raise ValueError(
+                    "factor_sharding='owner' keeps no replicated per-layer "
+                    "spectra for the diagnostics pytree to read — run "
+                    "track_diagnostics with replicated sharding"
+                )
+            if mesh is not None and mesh.devices.size > 1 and len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "factor_sharding='owner' requires a pure data-parallel "
+                    f"mesh (one axis); got axes {tuple(mesh.axis_names)}"
+                )
+            if mesh is None or mesh.devices.size <= 1:
+                # Mirrors the distribute_precondition warning: trainers pass
+                # the same flags to 1-device dev runs. There is nothing to
+                # shard across, so degrade to the (identical-numerics)
+                # replicated layout instead of building 1-wide shards.
+                print(
+                    "WARNING: factor_sharding='owner' has no effect without "
+                    "a multi-device mesh — factor state stays replicated"
+                )
+                factor_sharding = "replicated"
+        self.factor_sharding = factor_sharding
+        self._shard_plans: Dict[Any, Any] = {}
         # Stability telemetry (costs two scalars of state + O(layers) mins):
         # ν — the KL trust-region coefficient actually applied each step
         # (kfac_preconditioner.py:320-326) — and the minimum damped
@@ -349,6 +417,7 @@ class KFAC:
             axis_name=axis_name,
             comm_dtype=factor_comm_dtype,
             comm_freq=factor_comm_freq,
+            sharded=self.owner_sharded,
         )
         if (
             factor_comm_freq > 1 or self.factor_comm.comm_dtype != jnp.dtype("float32")
@@ -451,6 +520,235 @@ class KFAC:
         return int(self.mesh.devices.size)
 
     # ------------------------------------------------------------------
+    # Owner sharding (factor_sharding="owner")
+    # ------------------------------------------------------------------
+
+    @property
+    def owner_sharded(self) -> bool:
+        return self.factor_sharding == "owner"
+
+    def _shard_plan(self, shapes: Dict[str, Tuple[int, int]]):
+        """The owner-shard layout for this layer-shape set, cached.
+
+        The plan is pure host-side configuration (every host derives the
+        same one), so it compiles into the program; building it also lands
+        the planned per-replica byte totals on the observability gauges —
+        ``shard_plan_bytes`` is the same accounting bench reads, so the two
+        cannot drift.
+        """
+        key = tuple(sorted((n, tuple(s)) for n, s in shapes.items()))
+        plan = self._shard_plans.get(key)
+        if plan is None:
+            plan = plan_factor_shards(
+                shapes, self._world(), self.factor_comm.max_bucket_elems
+            )
+            self._shard_plans[key] = plan
+            info = shard_plan_bytes(
+                plan,
+                rank_fn=self._rank_fn(),
+                eigen_itemsize=jnp.dtype(self.eigen_dtype).itemsize,
+            )
+            tel = get_telemetry()
+            tel.set_gauge(
+                "kfac/factor_shard_bytes_local", info["total_buffer_local"]
+            )
+            tel.set_gauge(
+                "kfac/factor_shard_owner_count", info["owner_count"]
+            )
+        return plan
+
+    def state_shardings(self, state: KFACState) -> PyTree:
+        """``NamedSharding`` pytree matching ``state`` — the placement
+        contract of the owner mode.
+
+        The ``*_shard`` stacks split their leading (world·rows) axis over
+        the mesh axis; everything else (step counter, placeholder factor
+        leaves, deferred local accumulators) is replicated. Callers must
+        ``jax.device_put(state, kfac.state_shardings(state))`` before the
+        first jitted step — ``init()`` already returns owner state placed
+        this way — so pjit lays the shards out instead of inserting resharding
+        collectives. Works for replicated-mode states too (everything P()).
+        """
+        if self.mesh is None:
+            raise ValueError(
+                "state_shardings() needs the KFAC mesh= to build "
+                "NamedShardings against"
+            )
+        sharded_keys = ("factor_shard", "eigen_shard", "eigen_pending_shard")
+        split = NamedSharding(self.mesh, P(self.axis_name))
+        full = NamedSharding(self.mesh, P())
+        out = {}
+        for key, sub in state.items():
+            put = split if key in sharded_keys else full
+            out[key] = jax.tree_util.tree_map(lambda _leaf, s=put: s, sub)
+        return out
+
+    def _owner_shapes(self, facs: Dict[str, Dict[str, jnp.ndarray]]):
+        """Per-layer gradient-matrix shapes ``{name: (g, a)}`` from full
+        (replicated-form) factors — the key the shard plan is derived from,
+        identical to what ``precondition_assignment`` sees at step time."""
+        shapes = {}
+        for name, f in facs.items():
+            if "A" not in f:
+                raise ValueError(
+                    "factor_sharding='owner' does not support diagonal-A "
+                    f"(embedding) layers yet — layer {name!r} has no dense A "
+                    "factor to shard; run embeddings with replicated sharding"
+                )
+            shapes[name] = (int(f["G"].shape[0]), int(f["A"].shape[0]))
+        return shapes
+
+    def _owner_zero_eigen_shard(self, plan) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Zero eigen-shard stacks (the owner analog of _eigen_side_init):
+        one ``{"Q","d"[,"rho"]}`` stack per exact-size group, rows =
+        world·rows_n, truncated groups shaped by the same size→rank policy
+        as the replicated layout."""
+        out = {}
+        for n in plan.group_sizes:
+            rows = plan.world * plan.group_rows[n]
+            rank = self._rank_for(n)
+            if rank is None:
+                out[f"n{n}"] = {
+                    "Q": jnp.zeros((rows, n, n), self.eigen_dtype),
+                    "d": jnp.zeros((rows, n), jnp.float32),
+                }
+            else:
+                out[f"n{n}"] = {
+                    "Q": jnp.zeros((rows, n, rank), self.eigen_dtype),
+                    "d": jnp.zeros((rows, rank), jnp.float32),
+                    "rho": jnp.zeros((rows,), jnp.float32),
+                }
+        return out
+
+    def _owner_factor_shard_from_full(
+        self, facs: Dict[str, Dict[str, jnp.ndarray]], plan
+    ) -> Dict[str, jnp.ndarray]:
+        """Scatter full per-layer factors into the owner stacks (host-side:
+        init's identity factors, or a replicated checkpoint being re-homed).
+        Pad rows of under-loaded devices are zeros — fed only by the EMA
+        decay, never read."""
+        shard = {}
+        for n in plan.group_sizes:
+            rows = plan.group_rows[n]
+            stack = np.zeros((plan.world * rows, n, n), np.float32)
+            for s in plan.group_slots(n):
+                stack[s.owner * rows + s.row] = np.asarray(
+                    jax.device_get(facs[s.name][s.factor]), np.float32
+                )
+            shard[f"n{n}"] = jnp.asarray(stack)
+        return shard
+
+    def owner_state_from_replicated(self, state: KFACState) -> KFACState:
+        """Re-home a replicated-mode state into the owner-sharded layout.
+
+        The checkpoint migration path: restoring a replicated checkpoint
+        with ``factor_sharding="owner"`` scatters each layer's factors and
+        eigen entries into its owner's shard rows — deterministically, since
+        the plan is a pure function of the layer shapes. Runs host-side
+        (restore time, not step time). The eigen re-scatter preserves the
+        stored bases bitwise; optional keys (pending buffers, sync age)
+        carry over in owner form.
+        """
+        if not self.owner_sharded:
+            raise ValueError(
+                "owner_state_from_replicated() requires factor_sharding="
+                "'owner'"
+            )
+        facs = state["factors"]
+        shapes = self._owner_shapes(facs)
+        plan = self._shard_plan(shapes)
+        full_eigen = self._eigen_entries_from_split(
+            state["eigen"], state.get("eigen_stacked") or {}, shapes
+        )
+        eigen_shard = self._owner_eigen_shard_from_full(full_eigen, plan)
+        new_state = {
+            "step": state["step"],
+            "factors": {
+                name: {"A": jnp.zeros((), jnp.float32),
+                       "G": jnp.zeros((), jnp.float32)}
+                for name in facs
+            },
+            "eigen": {},
+            "eigen_stacked": {},
+            "factor_shard": self._owner_factor_shard_from_full(facs, plan),
+            "eigen_shard": eigen_shard,
+        }
+        if self.eigh_chunks > 1:
+            pending = state.get("eigen_pending")
+            if pending is not None:
+                new_state["eigen_pending_shard"] = (
+                    self._owner_eigen_shard_from_full(pending, plan)
+                )
+            else:
+                new_state["eigen_pending_shard"] = jax.tree_util.tree_map(
+                    jnp.zeros_like, eigen_shard
+                )
+        if self.solver == "rsvd":
+            new_state["spectrum_mass"] = state.get(
+                "spectrum_mass", jnp.zeros((), jnp.float32)
+            )
+        if self.factor_comm.defer:
+            new_state["factor_local"] = {
+                name: {
+                    "A": jnp.zeros((shapes[name][1],) * 2, jnp.float32),
+                    "G": jnp.zeros((shapes[name][0],) * 2, jnp.float32),
+                }
+                for name in facs
+            }
+            # a replicated deferred state's factors may hold unmerged local
+            # accumulators; the re-scatter treats them as synced (age 0) —
+            # restore-time migration should come from a flushed checkpoint
+            new_state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+        return jax.device_put(new_state, self.state_shardings(new_state))
+
+    def _eigen_entries_from_split(
+        self,
+        singles: Dict[str, Dict[str, jnp.ndarray]],
+        stacked: Dict[str, Dict[str, jnp.ndarray]],
+        shapes: Dict[str, Tuple[int, int]],
+    ) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Rebuild full per-layer eigen entries from the singles+stacked
+        storage form (inverse of split_eigen_state, using the same
+        shape_groups row-order contract)."""
+        full = {n: dict(e) for n, e in singles.items()}
+        for (g, a), names in precond_ops.shape_groups(shapes).items():
+            key = f"{g}x{a}"
+            if key in stacked:
+                for i, n in enumerate(names):
+                    full[n] = {k: v[i] for k, v in stacked[key].items()}
+        return full
+
+    def _owner_eigen_shard_from_full(
+        self, eigen: Dict[str, Dict[str, jnp.ndarray]], plan
+    ) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Scatter full per-layer eigen entries into owner shard stacks
+        (host-side twin of :meth:`_owner_factor_shard_from_full`)."""
+        shard = self._owner_zero_eigen_shard(plan)
+        out = {}
+        for key, grp in shard.items():
+            # np.array (not asarray): device_get returns read-only views
+            host = {k: np.array(jax.device_get(v)) for k, v in grp.items()}
+            n = int(key[1:])
+            rows = plan.group_rows[n]
+            for s in plan.group_slots(n):
+                e = eigen[s.name]
+                row = s.owner * rows + s.row
+                host["Q"][row] = np.asarray(
+                    jax.device_get(e[f"Q{s.factor}"])
+                )
+                host["d"][row] = np.asarray(
+                    jax.device_get(e[f"d{s.factor}"])
+                )
+                if "rho" in host:
+                    host["rho"][row] = np.asarray(
+                        jax.device_get(e[f"rho{s.factor}"])
+                    )
+            out[key] = {
+                k: jnp.asarray(v, grp[k].dtype) for k, v in host.items()
+            }
+        return out
+
+    # ------------------------------------------------------------------
     # State
     # ------------------------------------------------------------------
 
@@ -536,6 +834,8 @@ class KFAC:
                     **self._eigen_side_init("A", a_side),
                     **self._eigen_side_init("G", g_side),
                 }
+        if self.owner_sharded:
+            return self._owner_init(facs)
         # same-shape groups live ONLY pre-stacked (batched-rotation form);
         # singleton shapes stay per-layer — see split_eigen_state
         if self.precond_method == "inverse":
@@ -593,6 +893,55 @@ class KFAC:
                 },
             }
         return state
+
+    def _owner_init(self, facs: Dict[str, Dict[str, jnp.ndarray]]) -> KFACState:
+        """Owner-sharded initial state from init()'s identity factors.
+
+        The pytree layout the owner mode fixes from init: per-layer
+        ``factors`` shrink to scalar-zero placeholders (the name registry —
+        scalars, not zero-size arrays, so orbax checkpoints them —
+        the layer SET stays readable from state, and the pytree structure
+        is mesh-uniform for pjit), curvature lives in the ``factor_shard``/
+        ``eigen_shard`` stacks sharded over the mesh axis, deferred mode
+        adds the full-size per-replica local accumulator + sync-age counter,
+        and ``eigh_chunks > 1`` adds the sharded pending double buffer.
+        Returned already placed per :meth:`state_shardings`.
+        """
+        shapes = self._owner_shapes(facs)
+        plan = self._shard_plan(shapes)
+        eigen_shard = self._owner_zero_eigen_shard(plan)
+        state = {
+            "step": jnp.zeros((), jnp.int32),
+            "factors": {
+                name: {"A": jnp.zeros((), jnp.float32),
+                       "G": jnp.zeros((), jnp.float32)}
+                for name in facs
+            },
+            "eigen": {},
+            "eigen_stacked": {},
+            "factor_shard": self._owner_factor_shard_from_full(facs, plan),
+            "eigen_shard": eigen_shard,
+        }
+        if self.eigh_chunks > 1:
+            state["eigen_pending_shard"] = jax.tree_util.tree_map(
+                jnp.zeros_like, eigen_shard
+            )
+        if self.solver == "rsvd":
+            state["spectrum_mass"] = jnp.zeros((), jnp.float32)
+        if self.factor_comm.defer:
+            # Deferred owner mode: unlike the replicated plane (where the
+            # factors themselves double as local accumulators), non-owners
+            # hold no master EMA — so the between-flush accumulation needs
+            # its own full-size per-replica buffer, zeroed at every flush.
+            state["factor_local"] = {
+                name: {
+                    "A": jnp.zeros((shapes[name][1],) * 2, jnp.float32),
+                    "G": jnp.zeros((shapes[name][0],) * 2, jnp.float32),
+                }
+                for name in facs
+            }
+            state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+        return jax.device_put(state, self.state_shardings(state))
 
     # ------------------------------------------------------------------
     # Update
@@ -688,6 +1037,20 @@ class KFAC:
                     "(kfac_flags_for_step / EigenRefreshCadence) set this; "
                     "hand-rolled schedules must too."
                 )
+        if self.owner_sharded:
+            return self._update_owner(
+                grads,
+                state,
+                a_contribs=a_contribs,
+                g_factor_stats=g_factor_stats,
+                lr=lr,
+                damping=damping,
+                update_factors=update_factors,
+                update_eigen=update_eigen,
+                eigen_chunk=eigen_chunk,
+                swap_eigen=swap_eigen,
+                flush_factors=flush_factors,
+            )
         # The layer set was fixed at init() — state IS the source of truth,
         # so a heuristic/params mismatch cannot silently widen the set here.
         names = list(state["factors"].keys())
@@ -961,6 +1324,208 @@ class KFAC:
             new_state["diagnostics"] = self._diagnostics(
                 state["diagnostics"], fresh_spectra, gmats, updates, nu,
                 damping, update_eigen or swap_eigen,
+            )
+        return new_grads, new_state
+
+    def _update_owner(
+        self,
+        grads: PyTree,
+        state: KFACState,
+        *,
+        a_contribs: Optional[Dict[str, jnp.ndarray]],
+        g_factor_stats: Optional[Dict[str, jnp.ndarray]],
+        lr: jnp.ndarray,
+        damping: jnp.ndarray,
+        update_factors: bool,
+        update_eigen: bool,
+        eigen_chunk: Optional[Tuple[int, int]],
+        swap_eigen: bool,
+        flush_factors: bool,
+    ) -> Tuple[PyTree, KFACState]:
+        """The ``factor_sharding="owner"`` step (DP-KFAC, arxiv 2206.15143).
+
+        Same contract as the replicated flow in :meth:`update` (which
+        validated the static-flag combinations before dispatching here),
+        with the three wire/state moves swapped out:
+
+        * factor EMA — per-replica ``(1−α)·contrib`` statistics
+          reduce-SCATTER onto the owners' shard rows
+          (``FactorComm.scatter_merge``; deferred mode accumulates into the
+          full-size ``factor_local`` buffer and scatters ``α^m``-decayed at
+          each flush, exact vs. replicated by EMA linearity);
+        * eigen refresh — purely owner-local over the shard stacks
+          (``owner_eigen_update`` / the ``plan_owner_chunks`` pipelined
+          variant), zero collectives in the program;
+        * precondition — each layer solves on its owner and ONE allgather
+          replicates the preconditioned gradients
+          (``ops.precondition.precondition_all_owner``), in
+          ``precondition_all``'s emission order so the KL-clip summation
+          reassociates identically.
+        """
+        tel = get_telemetry()
+        names = list(state["factors"].keys())
+        lgrads = capture.layer_grads(grads, names)
+        gmats = {
+            name: mat.astype(jnp.float32)
+            for name, mat in capture.grad_mats(lgrads).items()
+        }
+        shapes = {
+            name: (int(g.shape[0]), int(g.shape[1]))
+            for name, g in gmats.items()
+        }
+        plan = self._shard_plan(shapes)
+        alpha = self.factor_decay
+
+        shard = state["factor_shard"]
+        local = state.get("factor_local")
+        if update_factors:
+            if a_contribs is None or g_factor_stats is None:
+                raise ValueError(
+                    "update_factors=True requires a_contribs and g_factor_stats"
+                )
+            missing = [
+                n for n in names if n not in a_contribs or n not in g_factor_stats
+            ]
+            if missing:
+                raise ValueError(
+                    f"no captured statistics for layers {missing}; the model "
+                    "contains kernel-bearing modules that are not K-FAC "
+                    "capture-aware — construct KFAC(layers=capture."
+                    "discover_layers(model, ...)) so init() matches capture."
+                )
+            with tel.span("trace/kfac/factor_update"):
+                if self.factor_comm.defer:
+                    # local-only EMA delta since the last flush (starts from
+                    # zero, NOT from the master copy — non-owners hold none)
+                    local = {
+                        name: {
+                            "A": factor_ops.update_running_avg(
+                                a_contribs[name], local[name]["A"], alpha
+                            ),
+                            "G": factor_ops.update_running_avg(
+                                g_factor_stats[name], local[name]["G"], alpha
+                            ),
+                        }
+                        for name in names
+                    }
+                else:
+                    payload = {
+                        name: {
+                            "A": (1.0 - alpha)
+                            * a_contribs[name].astype(jnp.float32),
+                            "G": (1.0 - alpha)
+                            * g_factor_stats[name].astype(jnp.float32),
+                        }
+                        for name in names
+                    }
+                    shard = self.factor_comm.scatter_merge(
+                        payload, shard, plan, jnp.asarray(alpha, jnp.float32)
+                    )
+        if flush_factors:
+            # α^m carry (m deferred capture steps since the last flush,
+            # including this step's) + the scattered mean of the local
+            # accumulators — the owner-sharded form of FactorComm.flush,
+            # exact vs. the replicated merge by EMA linearity.
+            m = state["factor_sync_age"] + int(update_factors)
+            decay = jnp.power(
+                jnp.asarray(alpha, jnp.float32), m.astype(jnp.float32)
+            )
+            shard = self.factor_comm.scatter_merge(local, shard, plan, decay)
+            local = jax.tree_util.tree_map(jnp.zeros_like, local)
+
+        eigen_shard = state["eigen_shard"]
+        pending = state.get("eigen_pending_shard")
+        spectrum_mass = state.get("spectrum_mass")
+        if update_eigen:
+            with tel.span("trace/kfac/eigh"):
+                eigen_shard = owner_eigen_update(
+                    shard,
+                    plan,
+                    self.mesh,
+                    self.axis_name,
+                    self.eps,
+                    rank_fn=self._rank_fn(),
+                    eigen_dtype=self.eigen_dtype,
+                )
+                if self.solver == "rsvd":
+                    spectrum_mass = owner_spectrum_mass(
+                        shard,
+                        eigen_shard,
+                        plan,
+                        self.mesh,
+                        self.axis_name,
+                        rank_fn=self._rank_fn(),
+                    )
+        elif eigen_chunk is not None:
+            c, k = eigen_chunk
+            jobs = plan_owner_chunks(plan, k, rank_fn=self._rank_fn())[c]
+            if c == 0:
+                # fresh interval: zero the double buffer, mirroring the
+                # replicated chunk path's from-zeros _assemble contract
+                pending = jax.tree_util.tree_map(jnp.zeros_like, pending)
+            with tel.span("trace/kfac/eigh"):
+                pending = owner_eigen_chunk_update(
+                    shard,
+                    pending,
+                    jobs,
+                    plan,
+                    self.mesh,
+                    self.axis_name,
+                    self.eps,
+                    rank_fn=self._rank_fn(),
+                    eigen_dtype=self.eigen_dtype,
+                )
+            if swap_eigen:
+                eigen_shard = pending
+                if self.solver == "rsvd":
+                    spectrum_mass = owner_spectrum_mass(
+                        shard,
+                        eigen_shard,
+                        plan,
+                        self.mesh,
+                        self.axis_name,
+                        rank_fn=self._rank_fn(),
+                    )
+
+        with tel.span("trace/kfac/precondition"):
+            precision_args = (
+                (self.precond_precision,)
+                if self.precond_precision is not None
+                else ()
+            )
+            updates = precond_ops.precondition_all_owner(
+                gmats,
+                eigen_shard,
+                damping,
+                *precision_args,
+                mesh=self.mesh,
+                plan=plan,
+                rank_fn=self._rank_fn(),
+                eigen_dtype=self.eigen_dtype,
+            )
+            nu = precond_ops.kl_clip_coefficient(
+                updates, gmats, lr, self.hparams.kl_clip
+            )
+            new_grads = capture.write_back(grads, updates, nu)
+
+        new_state = {
+            "step": state["step"] + 1,
+            "factors": state["factors"],
+            "eigen": state["eigen"],
+            "eigen_stacked": state["eigen_stacked"],
+            "factor_shard": shard,
+            "eigen_shard": eigen_shard,
+        }
+        if pending is not None:
+            new_state["eigen_pending_shard"] = pending
+        if spectrum_mass is not None:
+            new_state["spectrum_mass"] = spectrum_mass
+        if local is not None:
+            new_state["factor_local"] = local
+            new_state["factor_sync_age"] = (
+                jnp.zeros((), jnp.int32)
+                if flush_factors
+                else state["factor_sync_age"] + int(update_factors)
             )
         return new_grads, new_state
 

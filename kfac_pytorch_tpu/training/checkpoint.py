@@ -55,6 +55,40 @@ def restore_checkpoint(
     return restored
 
 
+def rehome_kfac_state(kfac: Any, kfac_state: Any) -> Any:
+    """Place a restored K-FAC state per the preconditioner's sharding mode.
+
+    ``save_checkpoint`` writes host-assembled (global) arrays, so a restored
+    owner-sharded state arrives replicated-on-host and must be re-placed
+    before the first jitted step. Three cases:
+
+    * owner preconditioner + owner-form checkpoint (has ``factor_shard``) —
+      ``device_put`` with :meth:`KFAC.state_shardings`: same mesh, same
+      layout, bitwise resume;
+    * owner preconditioner + replicated-form checkpoint — migrate via
+      :meth:`KFAC.owner_state_from_replicated`: the shard plan is a pure
+      function of the layer shapes, so the re-scatter is deterministic;
+    * replicated preconditioner — pass the state through unchanged, but
+      refuse an owner-form checkpoint (the gather-back migration is not
+      implemented; restore it with ``factor_sharding="owner"``).
+    """
+    if kfac is None or kfac_state is None:
+        return kfac_state
+    owner_form = "factor_shard" in kfac_state
+    if getattr(kfac, "owner_sharded", False):
+        if owner_form:
+            return jax.device_put(kfac_state, kfac.state_shardings(kfac_state))
+        return kfac.owner_state_from_replicated(kfac_state)
+    if owner_form:
+        raise ValueError(
+            "checkpoint holds owner-sharded K-FAC state but this "
+            "preconditioner runs factor_sharding='replicated'; gather-back "
+            "migration is not supported — restore with "
+            "factor_sharding='owner' on the same mesh"
+        )
+    return kfac_state
+
+
 def restore_weights_only(
     checkpoint_dir: str, epoch: int
 ) -> Tuple[Any, Any]:

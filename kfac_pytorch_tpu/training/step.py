@@ -225,6 +225,14 @@ def make_train_step(
     :func:`_compressed_grads`. ``None`` (default) leaves the reduction to
     GSPMD at f32.
 
+    ``KFAC(factor_sharding="owner")`` needs NO step-level wiring: it makes
+    ``kfac.factor_comm.active`` true, which routes the step through the
+    same :func:`_compressed_grads` wrapper (grads pmean at f32 unless
+    compressed), ``exchange_contribs`` hands the preconditioner LOCAL
+    statistics, and ``KFAC.update`` itself issues the reduce-scatter /
+    all-gather pair. The flag surface (and so ``expected_step_variants``)
+    is identical in both sharding modes.
+
     Returns ``step_fn(state, batch, lr, damping, update_factors=...,
     update_eigen=...)`` → ``(state, metrics)``. ``lr``/``damping`` are traced
     scalars; the two flags are static (compile-cached per combination).

@@ -10,6 +10,15 @@ delta is the factor path's wire cost, and it must stay ≤ the plane's bucket
 count. If a change reintroduces per-leaf reductions (or XLA stops fusing
 the bucketed ones), the delta jumps to ~2× the layer count and this fails.
 
+Second section: the owner-sharded mode (``factor_sharding="owner"``,
+DP-KFAC). Its capture step must contain (a) at most the planned bucket
+count of ``reduce-scatter`` ops — the scatter-merge of factor statistics
+onto their owners — and (b) EXACTLY ONE ``all-gather``: the preconditioned-
+gradient exchange of ``ops.precondition.precondition_all_owner``. The
+replicated baseline must contain neither op (its factor exchange is the
+bucketed all-reduce pinned above), so a regression that sneaks extra
+gathers/scatters into either mode fails loudly.
+
 Exit 0 with an "OK" line, 1 with a report. Run from the repo root
 (tier-1 wraps it in a test, tests/test_scripts.py).
 """
@@ -46,6 +55,8 @@ from kfac_pytorch_tpu.training.step import (  # noqa: E402
 # matches the op name at an instruction site: "all-reduce(" and
 # "all-reduce-start(" (async), but not "all-reduce-done("
 _ALLREDUCE_RE = re.compile(r"all-reduce(?:-start)?\(")
+_REDUCE_SCATTER_RE = re.compile(r"reduce-scatter(?:-start)?\(")
+_ALLGATHER_RE = re.compile(r"all-gather(?:-start)?\(")
 
 
 class _Net(nn.Module):
@@ -63,6 +74,90 @@ class _Net(nn.Module):
 
 def _count_allreduce(hlo: str) -> int:
     return len(_ALLREDUCE_RE.findall(hlo))
+
+
+def _check_owner(mesh, model, x, y) -> int:
+    """Owner-sharded pin: ≤ planned-bucket reduce-scatters on the capture
+    step, exactly one preconditioned-gradient all-gather, and a clean
+    (no rs/ag) replicated baseline."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tx = make_sgd(momentum=0.9)
+    lr, damping = jnp.float32(0.1), jnp.float32(0.01)
+
+    def compile_step(kfac, **flags):
+        params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=tx.init(params),
+            kfac_state=kfac.init(params),
+        )
+        # place the state per the mode's contract so the compiled program
+        # carries only the mode's own collectives, not resharding noise
+        kstate = jax.device_put(
+            state.kfac_state, kfac.state_shardings(state.kfac_state)
+        )
+        state = state.replace(kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(kfac_state=kstate)
+        batch = tuple(
+            jax.device_put(b, NamedSharding(mesh, P("data"))) for b in (x, y)
+        )
+        step_fn = make_train_step(
+            model, tx, kfac, train_kwargs={"train": True},
+            mesh=mesh, grad_comm_dtype=jnp.float32,
+        )
+        lowered = step_fn.lower(state, batch, lr, damping, **flags)
+        return lowered.compile().as_text()
+
+    owner = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                 mesh=mesh, factor_sharding="owner")
+    repl = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                mesh=mesh)
+    own_txt = compile_step(owner, update_factors=True, update_eigen=False)
+    rep_txt = compile_step(repl, update_factors=True, update_eigen=False)
+
+    rs = len(_REDUCE_SCATTER_RE.findall(own_txt))
+    ag = len(_ALLGATHER_RE.findall(own_txt))
+    rs_rep = len(_REDUCE_SCATTER_RE.findall(rep_txt))
+    ag_rep = len(_ALLGATHER_RE.findall(rep_txt))
+    buckets = owner.factor_comm.last_collectives or 0
+    print(
+        f"check_collective_count: owner capture step {rs} reduce-scatter(s) "
+        f"vs {buckets} planned bucket(s), {ag} all-gather(s); replicated "
+        f"baseline {rs_rep} reduce-scatter(s), {ag_rep} all-gather(s)"
+    )
+    if buckets < 1:
+        print("check_collective_count: FAIL — owner capture trace never "
+              "planned scatter buckets", file=sys.stderr)
+        return 1
+    if rs > buckets:
+        print(
+            f"check_collective_count: FAIL — owner capture step has {rs} "
+            f"reduce-scatters but the plan allows only {buckets} bucket(s); "
+            "the scatter-merge has unfused", file=sys.stderr,
+        )
+        return 1
+    if ag != 1:
+        print(
+            f"check_collective_count: FAIL — owner capture step has {ag} "
+            "all-gathers; the mode's contract is exactly ONE (the "
+            "preconditioned-gradient exchange)", file=sys.stderr,
+        )
+        return 1
+    if rs_rep != 0 or ag_rep != 0:
+        print(
+            f"check_collective_count: FAIL — replicated baseline grew "
+            f"{rs_rep} reduce-scatter(s) / {ag_rep} all-gather(s); the "
+            "default mode must not issue owner-path collectives",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_collective_count: OK — owner mode pinned to "
+          f"≤ {buckets} reduce-scatter(s) + 1 all-gather")
+    return 0
 
 
 def main() -> int:
@@ -116,7 +211,7 @@ def main() -> int:
         return 1
     print(f"check_collective_count: OK — factor exchange fused into "
           f"≤ {buckets} bucketed all-reduce(s)")
-    return 0
+    return _check_owner(mesh, model, x, y)
 
 
 if __name__ == "__main__":

@@ -1,10 +1,17 @@
-"""Checkpoint/resume: full TrainState round-trip incl. K-FAC state."""
+"""Checkpoint/resume: full TrainState round-trip incl. K-FAC state.
+
+Owner-sharded mode (``factor_sharding="owner"``): ``save_checkpoint``'s
+``device_get`` assembles the sharded factor/eigen stacks into global host
+arrays, so the on-disk form is mesh-independent; ``rehome_kfac_state``
+re-places a restore for the target preconditioner — same-mesh resumes are
+bitwise, and replicated-form checkpoints re-scatter deterministically."""
 
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_pytorch_tpu import KFAC
 from kfac_pytorch_tpu.models import cifar_resnet
@@ -85,3 +92,133 @@ def test_auto_resume_without_checkpoints(tmp_path):
     restored, resume = ckpt.auto_resume(str(tmp_path / "none"), state)
     assert resume == 0
     assert restored is state
+
+
+# ----------------------------------------------------- owner-sharded state
+
+
+def _owner_place(state, batch, mesh, kfac):
+    """Place a TrainState per the owner-mode contract: factor/eigen shards
+    on their owners, everything else replicated, batch split on "data"."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kstate = jax.device_put(
+        state.kfac_state, kfac.state_shardings(state.kfac_state)
+    )
+    state = state.replace(kfac_state=None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    state = state.replace(kfac_state=kstate)
+    bshard = NamedSharding(mesh, P("data"))
+    return state, tuple(jax.device_put(b, bshard) for b in batch)
+
+
+def test_owner_checkpoint_bitwise_resume(tmp_path):
+    """Owner save → restore → rehome on the same mesh resumes BITWISE: two
+    further steps from the restored state match the uninterrupted run."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+    from kfac_pytorch_tpu.training.step import kfac_flags_for_step
+    from tests.test_factor_comm import _MLP, _setup
+
+    mesh = data_parallel_mesh()
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+                mesh=mesh, factor_sharding="owner")
+    state, fn, batch = _setup(_MLP(), kfac, mesh=mesh,
+                              grad_comm_dtype=jnp.float32)
+    state, b = _owner_place(state, batch, mesh, kfac)
+
+    def step(s, i):
+        fl = kfac_flags_for_step(i, kfac)
+        s, _ = fn(s, b, jnp.float32(0.05), jnp.float32(0.01), **fl)
+        return s
+
+    for i in range(3):
+        state = step(state, i)
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 0, state)
+    template = jax.device_get(state)
+
+    cont = state
+    for i in range(3, 5):
+        cont = step(cont, i)
+
+    restored, resume = ckpt.auto_resume(d, template)
+    assert resume == 1
+    assert "factor_shard" in restored.kfac_state
+    kstate = ckpt.rehome_kfac_state(kfac, restored.kfac_state)
+    res = restored.replace(kfac_state=None)
+    res = jax.device_put(res, NamedSharding(mesh, P()))
+    res = res.replace(kfac_state=kstate)
+    for i in range(3, 5):
+        res = step(res, i)
+
+    for a, c in zip(
+        jax.tree_util.tree_leaves(jax.device_get(cont)),
+        jax.tree_util.tree_leaves(jax.device_get(res)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_replicated_checkpoint_migrates_to_owner_mode(tmp_path):
+    """A replicated-form checkpoint restored under factor_sharding="owner"
+    re-scatters deterministically: repeating the migration yields an
+    identical tree, every shard row is bitwise the replicated factor it
+    came from, and the result has a fresh owner init's structure (so the
+    jitted step accepts it)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+    from kfac_pytorch_tpu.training.step import kfac_flags_for_step
+    from tests.test_factor_comm import _MLP, _setup
+
+    mesh = data_parallel_mesh()
+    hyper = dict(damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+                 mesh=mesh)
+    k_rep = KFAC(**hyper)
+    state, fn, batch = _setup(_MLP(), k_rep, mesh=mesh,
+                              grad_comm_dtype=jnp.float32)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    b = tuple(
+        jax.device_put(x, NamedSharding(mesh, P("data"))) for x in batch
+    )
+    for i in range(3):
+        fl = kfac_flags_for_step(i, k_rep)
+        state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01), **fl)
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 0, state)
+    restored, _ = ckpt.auto_resume(d, jax.device_get(state))
+
+    k_own = KFAC(**hyper, factor_sharding="owner")
+    own = jax.device_get(ckpt.rehome_kfac_state(k_own, restored.kfac_state))
+    own2 = jax.device_get(ckpt.rehome_kfac_state(k_own, restored.kfac_state))
+    for a, c in zip(
+        jax.tree_util.tree_leaves(own), jax.tree_util.tree_leaves(own2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    facs = restored.kfac_state["factors"]
+    shapes = {n: (f["G"].shape[0], f["A"].shape[0])
+              for n, f in facs.items()}
+    plan = k_own._shard_plan(shapes)
+    for s in plan.slots:
+        rows = plan.group_rows[s.size]
+        row = np.asarray(
+            own["factor_shard"][f"n{s.size}"][s.owner * rows + s.row]
+        )
+        np.testing.assert_array_equal(row, np.asarray(facs[s.name][s.factor]))
+
+    fresh = jax.device_get(k_own.init(restored.params))
+    assert (jax.tree_util.tree_structure(own)
+            == jax.tree_util.tree_structure(fresh))
+
+
+def test_rehome_passthrough_and_refusal():
+    """Replicated preconditioners pass state through untouched but refuse
+    owner-form checkpoints (no gather-back migration)."""
+    st = {"factors": {}}
+    assert ckpt.rehome_kfac_state(None, st) is st
+    k_rep = KFAC()
+    assert ckpt.rehome_kfac_state(k_rep, st) is st
+    with pytest.raises(ValueError, match="owner-sharded"):
+        ckpt.rehome_kfac_state(k_rep, {"factor_shard": {}})

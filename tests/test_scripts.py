@@ -75,8 +75,10 @@ def test_pallas_interpret_lint_clean():
 def test_collective_count_check():
     """The compiled capture step must carry ≤ bucket-count factor
     all-reduces over the plain step — per-leaf collectives sneaking back in
-    means the FactorComm fusion regressed
-    (scripts/check_collective_count.py)."""
+    means the FactorComm fusion regressed — and the owner-sharded capture
+    step must pin to ≤ bucket-count reduce-scatters plus exactly one
+    preconditioned-gradient all-gather, with the replicated baseline free
+    of both op kinds (scripts/check_collective_count.py)."""
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "check_collective_count.py")],
         capture_output=True, text=True, cwd=REPO,
