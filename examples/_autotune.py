@@ -1,0 +1,92 @@
+"""Warmup micro-autotune glue shared by the example trainers.
+
+The planner's ``autotune()`` is trainer-agnostic — it just times candidate
+plans through a ``measure(plan, steps)`` callback. This module owns the
+callback: build a throwaway KFAC + state + step per candidate, compile the
+two step programs the timing touches (one capture step, one plain step),
+then time ``steps`` plain steps plus one capture step — the per-step
+surface every lever changes. The eigen refresh is deliberately NOT timed:
+its cost is what the analytic model prices best, and refreshing under
+``eigh_chunks`` would drag the whole chunk-flag cadence into warmup.
+
+Each candidate gets a fresh ``make_train_step`` wrapper, so autotune
+compiles never count against the training loop's RecompileMonitor budget.
+
+Multi-host: every host MUST run every candidate (the timed steps carry
+collectives), then agree on the winner via the ``broadcast`` callable —
+host-local timing jitter must not let two hosts pin different plans.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu import planner
+
+
+def autotune_kfac(
+    kfac,
+    build_kfac,
+    fresh_state,
+    build_step,
+    batch,
+    lr,
+    steps,
+    broadcast=lambda x: x,
+    log=None,
+):
+    """Time the candidate plans for ``kfac``'s resolved plan; return the
+    winning preconditioner (possibly ``kfac`` itself) and the report.
+
+    ``build_kfac(plan)`` must construct a KFAC with ``profile=plan``;
+    ``fresh_state(kfac)``/``build_step(kfac)`` must mirror the trainer's
+    real state placement and train-step construction so the timings are
+    honest. No-op (returns ``(kfac, None)``) when autotuning is off, the
+    KFAC has no plan, or the candidate list degenerates to one entry.
+    """
+    if kfac is None or kfac.plan is None or steps <= 0:
+        return kfac, None
+    candidates = planner.candidate_plans(kfac.plan, kfac.plan_env)
+    if len(candidates) < 2:
+        return kfac, None
+
+    def measure(plan, n):
+        k = build_kfac(plan)
+        step_fn = build_step(k)
+        state = fresh_state(k)
+        damping = jnp.float32(k.hparams.damping)
+        # compile + warm the two programs the timed loop uses
+        state, m = step_fn(
+            state, batch, lr, damping, update_factors=True, update_eigen=False
+        )
+        state, m = step_fn(
+            state, batch, lr, damping, update_factors=False, update_eigen=False
+        )
+        jax.block_until_ready(m)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step_fn(
+                state, batch, lr, damping,
+                update_factors=False, update_eigen=False,
+            )
+        state, m = step_fn(
+            state, batch, lr, damping, update_factors=True, update_eigen=False
+        )
+        jax.block_until_ready(m)
+        return time.perf_counter() - t0
+
+    report = planner.autotune(candidates, measure, steps=steps)
+    winner_index = int(broadcast(report.winner_index))
+    winner = candidates[winner_index]
+    if log is not None:
+        timings = " ".join(f"{t * 1e3:.1f}ms" for t in report.timings_s)
+        log(
+            f"autotune: {len(candidates)} candidates x {steps} steps "
+            f"[{timings}] -> winner {winner_index}: {winner.describe()}"
+        )
+    if winner == kfac.plan:
+        return kfac, report
+    return build_kfac(winner), report

@@ -216,6 +216,17 @@ def parse_args(argv=None):
     p.add_argument("--solver-auto-threshold", type=int, default=512,
                    help="factor sides at least this large use the truncated "
                         "solver; smaller sides stay dense (--solver rsvd)")
+    p.add_argument("--profile", default=None,
+                   choices=["safe", "memory", "production"],
+                   help="resolve the K-FAC perf levers from a named planner "
+                        "profile (planner/cost_model.py) using this model's "
+                        "factor shapes and the mesh; explicit lever flags "
+                        "win over the profile's choices (docs/PLANNER.md)")
+    p.add_argument("--autotune-steps", type=int, default=0,
+                   help="time the resolved plan against its conservative "
+                        "fallbacks for this many warmup steps each and pin "
+                        "the winner (0 = trust the cost model; needs "
+                        "--profile; docs/PLANNER.md)")
     p.add_argument("--bn-recal-batches", type=int, default=0,
                    help="refresh BatchNorm running statistics with this many "
                         "clean train-mode forwards before each eval (0 = "
@@ -275,34 +286,99 @@ def main(argv=None):
     if use_kfac:
         from kfac_pytorch_tpu import capture as capture_lib
 
-        kfac = KFAC(
-            layers=capture_lib.discover_layers(model, init_images, train=True),
-            lr=lr_base,
-            factor_decay=args.stat_decay,
-            damping=args.damping,
-            kl_clip=args.kl_clip,
-            fac_update_freq=args.kfac_cov_update_freq,
-            kfac_update_freq=args.kfac_update_freq,
-            diag_blocks=args.diag_blocks,
-            diag_warmup=args.diag_warmup,
-            distribute_layer_factors=args.distribute_layer_factors,
-            distribute_precondition=args.distribute_precondition,
-            mesh=mesh if world > 1 else None,
-            precond_precision=args.precond_precision,
-            precond_method=args.precond_method,
-            precond_comm_dtype=(jnp.bfloat16
-                                if args.precond_comm_dtype == "bf16" else None),
-            eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
-            track_diagnostics=args.kfac_diagnostics,
-            eigh_chunks=args.eigh_chunks,
-            factor_kernel=args.factor_kernel,
-            factor_comm_dtype=args.factor_comm_dtype,
-            factor_comm_freq=args.factor_comm_freq,
-            solver=args.solver,
-            solver_rank=args.solver_rank,
-            solver_auto_threshold=args.solver_auto_threshold,
-            factor_sharding=args.factor_sharding,
-        )
+        kfac_layers = capture_lib.discover_layers(model, init_images, train=True)
+        profile_shapes = None
+        if args.profile:
+            from kfac_pytorch_tpu import planner
+
+            # factor shapes for the cost model, from the live params
+            profile_shapes = planner.model_facts(params, layers=kfac_layers)
+
+        def build_kfac(profile=args.profile):
+            return KFAC(
+                layers=kfac_layers,
+                lr=lr_base,
+                factor_decay=args.stat_decay,
+                damping=args.damping,
+                kl_clip=args.kl_clip,
+                fac_update_freq=args.kfac_cov_update_freq,
+                kfac_update_freq=args.kfac_update_freq,
+                diag_blocks=args.diag_blocks,
+                diag_warmup=args.diag_warmup,
+                distribute_layer_factors=args.distribute_layer_factors,
+                distribute_precondition=args.distribute_precondition,
+                mesh=mesh if world > 1 else None,
+                precond_precision=args.precond_precision,
+                precond_method=args.precond_method,
+                precond_comm_dtype=(jnp.bfloat16
+                                    if args.precond_comm_dtype == "bf16" else None),
+                eigen_dtype=jnp.bfloat16 if args.eigen_dtype == "bf16" else jnp.float32,
+                track_diagnostics=args.kfac_diagnostics,
+                eigh_chunks=args.eigh_chunks,
+                factor_kernel=args.factor_kernel,
+                factor_comm_dtype=args.factor_comm_dtype,
+                factor_comm_freq=args.factor_comm_freq,
+                solver=args.solver,
+                solver_rank=args.solver_rank,
+                solver_auto_threshold=args.solver_auto_threshold,
+                factor_sharding=args.factor_sharding,
+                profile=profile,
+                profile_shapes=profile_shapes,
+            )
+
+        kfac = build_kfac()
+        if kfac.plan is not None and launch.is_primary():
+            drop = (
+                f" (dropped: {', '.join(kfac.plan_dropped)})"
+                if kfac.plan_dropped else ""
+            )
+            print(kfac.plan.describe() + drop)
+        if args.autotune_steps and kfac.plan is not None:
+            from _autotune import autotune_kfac
+
+            def _fresh_state(k):
+                # the train step donates its state (training/step.py), and
+                # device_put to an already-matching sharding aliases — copy
+                # so a timed candidate can't free the master params
+                copy = lambda t: jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, copy=True), t
+                )
+                p = copy(params)
+                s = TrainState(
+                    step=jnp.zeros((), jnp.int32), params=p,
+                    batch_stats=copy(batch_stats), opt_state=tx.init(p),
+                    kfac_state=k.init(p),
+                )
+                if k.owner_sharded:
+                    kstate = s.kfac_state
+                    s = s.replace(kfac_state=None)
+                    s = jax.device_put(s, NamedSharding(mesh, P()))
+                    return s.replace(kfac_state=kstate)
+                return jax.device_put(s, NamedSharding(mesh, P()))
+
+            def _build_step(k):
+                return make_train_step(
+                    model, tx, k, label_smoothing=args.label_smoothing,
+                    train_kwargs={"train": True}, accum_steps=accum,
+                    stats_all_microbatches=args.stats_all_microbatches,
+                    mesh=mesh if args.grad_comm_dtype else None,
+                    grad_comm_dtype=(jnp.bfloat16
+                                     if args.grad_comm_dtype == "bf16" else None),
+                )
+
+            warm = put_global_batch(
+                mesh,
+                (rng.randn(local_bs * accum, 32, 32, 3).astype(np.float32),
+                 rng.randint(0, args.synth_classes, size=local_bs * accum)
+                 .astype(np.int32)),
+                accum_steps=accum,
+            )
+            kfac, _ = autotune_kfac(
+                kfac, build_kfac, _fresh_state, _build_step, warm,
+                jnp.float32(lr_base), args.autotune_steps,
+                broadcast=launch.broadcast_host_value,
+                log=print if launch.is_primary() else None,
+            )
         kfac_sched = KFACParamScheduler(
             kfac,
             damping_alpha=args.damping_alpha,

@@ -138,6 +138,17 @@ def parse_args(argv=None):
     p.add_argument("--solver-auto-threshold", type=int, default=512,
                    help="factor sides at least this large use the truncated "
                         "solver; smaller sides stay dense (--solver rsvd)")
+    p.add_argument("--profile", default=None,
+                   choices=["safe", "memory", "production"],
+                   help="resolve the K-FAC perf levers from a named planner "
+                        "profile (planner/cost_model.py) using this model's "
+                        "factor shapes and the mesh; explicit lever flags "
+                        "win over the profile's choices (docs/PLANNER.md)")
+    p.add_argument("--autotune-steps", type=int, default=0,
+                   help="time the resolved plan against its conservative "
+                        "fallbacks for this many warmup steps each and pin "
+                        "the winner (0 = trust the cost model; needs "
+                        "--profile; docs/PLANNER.md)")
     p.add_argument("--profile-epoch", type=int, default=None,
                    help="capture a jax.profiler trace of this epoch into --log-dir")
     p.add_argument("--telemetry-dir", default=None,
@@ -165,24 +176,45 @@ def main(argv=None):
         raise SystemExit(f"--seq-parallel {sp} must divide device count {devices.size}")
     if args.seq_len % sp != 0:
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
-    owner = args.factor_sharding == "owner"
-    if owner and sp > 1:
+    # CLI lever composition routed through the planner's validity matrix —
+    # the same Rule rows KFAC.__init__/init enforce produce the refusal
+    # messages here (owner×seq-parallel, owner×--kfac-embedding and
+    # factor-comm×seq-parallel were ad-hoc SystemExits before PLANNER)
+    from kfac_pytorch_tpu import planner
+
+    cli_plan = planner.Plan(
+        eigh_chunks=args.eigh_chunks,
+        factor_comm_dtype=args.factor_comm_dtype,
+        factor_comm_freq=args.factor_comm_freq,
+        solver=args.solver,
+        solver_rank=args.solver_rank,
+        solver_auto_threshold=args.solver_auto_threshold,
+        factor_sharding=args.factor_sharding,
+    )
+    lever_env = planner.PlanEnv(
+        world=int(devices.size),
+        # sp == 1 trains on the pure-DP one-axis mesh built below; a REAL
+        # seq axis is what the owner/comm levers cannot ride
+        mesh_axes=("data",) if sp == 1 else ("data", "seq"),
+        track_diagnostics=args.kfac_diagnostics,
+        has_diag_a_layers=args.kfac_embedding,
+        has_conv_layers=False,
+        fac_update_freq=max(1, args.kfac_cov_update_freq),
+        kfac_update_freq=max(1, args.kfac_update_freq),
+    )
+    bad = planner.violations(cli_plan, lever_env)
+    if bad:
         raise SystemExit(
-            "--factor-sharding owner requires a pure data-parallel mesh "
-            "(--seq-parallel 1): factor shards and the preconditioned-grad "
-            "allgather are laid out over a single mesh axis"
+            "invalid K-FAC lever composition:\n"
+            + "\n".join(f"  [{r.name}] {r.message}" for r in bad)
         )
-    if owner and args.kfac_embedding:
-        raise SystemExit(
-            "--factor-sharding owner does not support --kfac-embedding: "
-            "the embedding's diagonal A factor has no dense matrix to shard"
-        )
-    # owner sharding lays factor/eigen shards over ONE mesh axis, so its
-    # mesh drops the (size-1) seq axis; the default mesh is unchanged
+    # pure data-parallel runs use a one-axis mesh — the layout the
+    # owner/comm levers require; sequence parallelism adds the seq axis
     mesh = (
-        Mesh(devices, ("data",)) if owner
+        Mesh(devices, ("data",)) if sp == 1
         else Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
     )
+    batch_spec = P("data") if sp == 1 else P("data", "seq")
     dp = devices.size // sp
     n_proc = launch.size()
     if dp % n_proc != 0:
@@ -233,23 +265,90 @@ def main(argv=None):
     kfac = None
     kfac_sched = None
     if use_kfac:
-        kfac = KFAC(
-            layers=capture.discover_layers(model, init_toks, train=True),
-            factor_decay=args.stat_decay,
-            damping=args.damping,
-            kl_clip=args.kl_clip,
-            fac_update_freq=args.kfac_cov_update_freq,
-            kfac_update_freq=args.kfac_update_freq,
-            mesh=mesh if devices.size > 1 else None,
-            track_diagnostics=args.kfac_diagnostics,
-            eigh_chunks=args.eigh_chunks,
-            factor_comm_dtype=args.factor_comm_dtype,
-            factor_comm_freq=args.factor_comm_freq,
-            solver=args.solver,
-            solver_rank=args.solver_rank,
-            solver_auto_threshold=args.solver_auto_threshold,
-            factor_sharding=args.factor_sharding,
-        )
+        kfac_layers = capture.discover_layers(model, init_toks, train=True)
+        profile_shapes = None
+        if args.profile:
+            # factor shapes for the cost model, from the live params (the
+            # discovered layer list includes --kfac-embedding's diag-A entry)
+            profile_shapes = planner.model_facts(params, layers=kfac_layers)
+
+        def build_kfac(profile=args.profile):
+            return KFAC(
+                layers=kfac_layers,
+                factor_decay=args.stat_decay,
+                damping=args.damping,
+                kl_clip=args.kl_clip,
+                fac_update_freq=args.kfac_cov_update_freq,
+                kfac_update_freq=args.kfac_update_freq,
+                mesh=mesh if devices.size > 1 else None,
+                track_diagnostics=args.kfac_diagnostics,
+                eigh_chunks=args.eigh_chunks,
+                factor_comm_dtype=args.factor_comm_dtype,
+                factor_comm_freq=args.factor_comm_freq,
+                solver=args.solver,
+                solver_rank=args.solver_rank,
+                solver_auto_threshold=args.solver_auto_threshold,
+                factor_sharding=args.factor_sharding,
+                profile=profile,
+                profile_shapes=profile_shapes,
+            )
+
+        kfac = build_kfac()
+        if kfac.plan is not None and launch.is_primary():
+            drop = (
+                f" (dropped: {', '.join(kfac.plan_dropped)})"
+                if kfac.plan_dropped else ""
+            )
+            print(kfac.plan.describe() + drop)
+        if args.autotune_steps and kfac.plan is not None:
+            from _autotune import autotune_kfac
+
+            def _fresh_state(k):
+                # the train step donates its state (training/step.py), and
+                # device_put to an already-matching sharding aliases — copy
+                # so a timed candidate can't free the master params
+                p = jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, copy=True), params
+                )
+                s = TrainState(
+                    step=jnp.zeros((), jnp.int32), params=p,
+                    batch_stats={}, opt_state=tx.init(p),
+                    kfac_state=k.init(p),
+                )
+                if k.owner_sharded:
+                    kstate = s.kfac_state
+                    s = s.replace(kfac_state=None)
+                    s = jax.device_put(s, NamedSharding(mesh, P()))
+                    return s.replace(kfac_state=kstate)
+                return jax.device_put(s, NamedSharding(mesh, P()))
+
+            def _build_step(k):
+                return make_train_step(
+                    model, tx, k, train_kwargs={"train": True},
+                    grad_clip=args.grad_clip,
+                    mesh=mesh if args.grad_comm_dtype else None,
+                    grad_comm_dtype=(
+                        jnp.bfloat16 if args.grad_comm_dtype == "bf16"
+                        else None
+                    ),
+                )
+
+            warm_rng = np.random.RandomState(args.seed)
+            rows_local = global_bs // n_proc
+            warm = put_sharded_batch(
+                mesh,
+                (warm_rng.randint(0, vocab, (rows_local, args.seq_len))
+                 .astype(np.int32),
+                 warm_rng.randint(0, vocab, (rows_local, args.seq_len))
+                 .astype(np.int32)),
+                batch_spec,
+            )
+            kfac, _ = autotune_kfac(
+                kfac, build_kfac, _fresh_state, _build_step, warm,
+                jnp.float32(args.base_lr), args.autotune_steps,
+                broadcast=launch.broadcast_host_value,
+                log=print if launch.is_primary() else None,
+            )
         if args.damping_schedule:
             kfac_sched = KFACParamScheduler(
                 kfac, damping_alpha=args.damping_alpha,
@@ -283,20 +382,12 @@ def main(argv=None):
             "(--seq-parallel 1): a sequence axis would make the per-device "
             "local forward see a partial example"
         )
-    if (args.factor_comm_dtype != "f32" or args.factor_comm_freq > 1) and sp > 1:
-        raise SystemExit(
-            "--factor-comm-dtype/--factor-comm-freq require a pure "
-            "data-parallel mesh (--seq-parallel 1): the factor exchange "
-            "rides the same explicit-collective wrapper as --grad-comm-dtype"
-        )
     step_fn = make_train_step(
         model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip,
         mesh=mesh if args.grad_comm_dtype else None,
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_fn = make_eval_step(model, eval_kwargs={"train": False})
-    # the owner-mode mesh has no seq axis (it is pure-DP by construction)
-    batch_spec = P("data") if len(mesh.axis_names) == 1 else P("data", "seq")
 
     # [B_total, N] contiguous streams; segments of seq_len become samples.
     # Multi-host: every process derives the same global stream, then keeps

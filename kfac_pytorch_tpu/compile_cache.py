@@ -47,39 +47,107 @@ def enable_persistent_cache(path: str | None = None) -> str:
     return path
 
 
-def expected_step_variants(kfac) -> int:
+def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int:
     """Compile-budget for a K-FAC train step under the standard schedules.
 
     The single source of truth the trainers hand to
-    :meth:`RecompileMonitor.watch`: with the monolithic refresh the schedule
-    produces plain / factors-only / factors+eigen programs; with the
-    pipelined refresh (``eigh_chunks = K > 1``) the eigen program is
-    replaced by up to ``K`` chunk programs, each of which may appear with
-    and without the factor-update flag (whether it does depends on how
-    ``fac_update_freq`` lands inside the chunk span, so this budgets the
-    bound), plus the one-time monolithic bootstrap refresh. A nonzero
-    ``diag_warmup`` doubles everything (each variant exists in warmup and
-    post-warmup form).
+    :meth:`RecompileMonitor.watch`. The count is EXACT, not a per-lever
+    worst-case sum: it replays the real host-side cadence
+    (``scheduler.EigenRefreshCadence`` — the same object the trainers
+    drive the step with) over enough steps to cover the schedule's full
+    period and counts the distinct static-flag combinations it emits.
+    Summing independent per-lever bounds over-reserved composed plans —
+    e.g. ``eigh_chunks`` whose chunk offsets never coincide with a
+    ``fac_update_freq`` step compile fewer factor+chunk twins than the
+    old ``3 + 2K`` formula budgeted — and an inflated budget makes the
+    recompile monitor blind to exactly that many real retraces.
 
-    Deferred factor reduction (``factor_comm_freq > 1`` on a multi-device
-    mesh) splits the capture variants by the ``flush_factors`` flag: the
-    monolithic schedule adds one program (factors-without-flush; the eigen
-    step always flushes), the pipelined schedule two (the factors-only and
-    chunk-0 programs each gain a flush twin).
+    ``plan`` (a ``planner.Plan``) budgets a plan *before* constructing a
+    KFAC with it: the cadence replays against ``kfac``'s schedule hparams
+    with the plan's lever values overriding. ``autotune_candidates``
+    reserves programs for warmup micro-autotuning: each non-winning
+    candidate timed through the same jitted step may compile up to a
+    plain and a capture program before being discarded.
 
-    The curvature solver choice (``solver="rsvd"`` vs ``"eigh"``) does NOT
-    change the count: the rank policy is a pure function of static factor
-    shapes, so it swaps WHICH programs compile (truncated vs dense refresh,
-    Woodbury vs dense apply), never how many the schedule produces.
+    A nonzero ``diag_warmup`` replays both phases — warmup epochs, then
+    post-warmup on the same cadence (the mid-run flip), plus a fresh
+    warm-started cadence for the resume-from-checkpoint case where the
+    monolithic bootstrap refresh compiles in its post-warmup form.
+
+    The curvature solver choice (``solver="rsvd"`` vs ``"eigh"``) does
+    NOT change the count: the rank policy is a pure function of static
+    factor shapes, so it swaps WHICH programs compile (truncated vs
+    dense refresh, Woodbury vs dense apply), never how many the schedule
+    produces.
     """
     if kfac is None:
-        return 1
-    chunks = getattr(kfac, "eigh_chunks", 1)
-    base = 3 if chunks <= 1 else 3 + 2 * chunks
-    comm = getattr(kfac, "factor_comm", None)
-    if comm is not None and comm.defer:
-        base += 1 if chunks <= 1 else 2
-    return base * (1 if kfac.diag_warmup == 0 else 2)
+        return 1 + 2 * int(autotune_candidates)
+
+    import math
+    import types
+
+    from kfac_pytorch_tpu.observability import telemetry as _telemetry
+    from kfac_pytorch_tpu.scheduler import EigenRefreshCadence
+
+    sim = kfac
+    if plan is not None:
+        comm = getattr(kfac, "factor_comm", None)
+        multi = bool(comm is not None and comm.multi_device)
+        sim = types.SimpleNamespace(
+            hparams=kfac.hparams,
+            diag_warmup=kfac.diag_warmup,
+            eigh_chunks=int(plan.eigh_chunks),
+            factor_comm=types.SimpleNamespace(
+                defer=plan.factor_comm_freq > 1 and multi,
+                comm_freq=int(plan.factor_comm_freq),
+            ),
+            solver=plan.solver,
+            solver_rank=plan.solver_rank,
+        )
+
+    hp = sim.hparams
+    comm_freq = (
+        sim.factor_comm.comm_freq if sim.factor_comm.defer else 1
+    ) if getattr(sim, "factor_comm", None) is not None else 1
+    # One full period of the flag schedule: eigen boundaries, factor
+    # steps, and the deferred-flush phase all repeat within
+    # lcm(kfac_freq, fac_freq·comm_freq); replay two periods past the
+    # bootstrap so every steady-state combination appears. Capped — the
+    # replay is host-side flag arithmetic only.
+    period = math.lcm(
+        int(hp.kfac_update_freq), int(hp.fac_update_freq) * int(comm_freq)
+    )
+    horizon = min(2 * period + int(hp.kfac_update_freq) + 1, 20000)
+
+    variants = set()
+
+    def replay(cadence, start, steps, epoch):
+        for s in range(start, start + steps):
+            flags = cadence.flags_for_step(s, epoch=epoch)
+            key = tuple(sorted(flags.items()))
+            variants.add(key)
+        return start + steps
+
+    # flags_for_step mirrors cadence gauges into telemetry; the replay is
+    # a simulation, so keep it off the real gauges.
+    tel = _telemetry.get_telemetry()
+    prev_enabled = tel.enabled
+    tel.enabled = False
+    try:
+        warm_epoch = sim.diag_warmup
+        cadence = EigenRefreshCadence(sim)
+        if sim.diag_warmup > 0:
+            # warmup phase, then the in-place flip to post-warmup
+            nxt = replay(cadence, 0, horizon, epoch=0)
+            replay(cadence, nxt, horizon, epoch=warm_epoch)
+            # resume case: fresh cadence already past warmup
+            replay(EigenRefreshCadence(sim), 0, horizon, epoch=warm_epoch)
+        else:
+            replay(cadence, 0, horizon, epoch=warm_epoch)
+    finally:
+        tel.enabled = prev_enabled
+
+    return len(variants) + 2 * int(autotune_candidates)
 
 
 class RecompileMonitor:

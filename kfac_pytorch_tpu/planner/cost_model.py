@@ -1,0 +1,388 @@
+"""Analytic per-lever cost/benefit model → concrete :class:`Plan`.
+
+The planner does not invent new cost tables: it reuses the exact host-side
+primitives the runtime already schedules with, so the plan it picks and
+the program that runs cannot disagree about what is expensive:
+
+* refresh cost per (layer, side) — ``parallel.assignment._slot_cost``,
+  the same padded-eigh / rank-aware matmul cost the chunk planners
+  balance with (dense ``bucket³``, truncated ``m²·(r+p)·passes``);
+* every-step precondition cost — the ``g²a + ga²`` MAC count
+  ``precondition_assignment`` LPT-balances (``g²a`` for diagonal-A);
+* bytes on the wire — ``plan_factor_buckets`` over the stat-leaf shapes
+  (the comm plane's own bucketing) and ``plan_factor_shards`` /
+  ``shard_plan_bytes`` for the owner-sharded layout.
+
+Every decision below is a deterministic integer comparison, so every host
+resolves the same plan from the same (shapes, env) — the same discipline
+as the assignment tables — and ``scripts/check_plan_snapshot.py`` pins
+the resolved plans for three canonical fixtures so cost-model drift is a
+visible diff, not a silent behavior change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, FrozenSet, Optional, Tuple, Union
+
+from kfac_pytorch_tpu.parallel.assignment import (
+    _slot_cost,
+    plan_factor_buckets,
+    plan_factor_shards,
+    shard_plan_bytes,
+)
+from kfac_pytorch_tpu.planner.profiles import (
+    PROFILES,
+    Plan,
+    PlanEnv,
+    fit_plan,
+)
+
+# Decision thresholds. Plain module constants (not config): they are the
+# cost model, and changing them is supposed to show up as a golden-plan
+# diff in scripts/plan_snapshots/.
+
+#: rsvd engages only when the dense refresh costs at least this multiple
+#: of the truncated refresh — below that the Woodbury apply path's extra
+#: rotations are not worth the refresh savings.
+RSVD_MIN_SPEEDUP = 2.0
+#: ... and only when some factor side actually crosses the solver's
+#: default threshold (a model with all sides < 512 truncates nothing).
+RSVD_SIDE_THRESHOLD = 512
+RSVD_RANK = 128
+#: chunk the refresh until the per-boundary eigh spike is no more than
+#: this multiple of one step's precondition work.
+CHUNK_SPIKE_BUDGET = 32
+MAX_CHUNKS = 8
+#: bf16 wire compression engages when one f32 factor exchange moves at
+#: least this many bytes per replica (below it, latency dominates and
+#: halving payload buys nothing).
+COMM_BF16_MIN_BYTES = 256 * 1024
+#: deferred reduction engages when there are ≥ this many capture steps
+#: per eigen refresh to amortize over (and then defers every
+#: ``COMM_DEFER_FREQ``-th capture step).
+COMM_DEFER_MIN_RATIO = 10
+COMM_DEFER_FREQ = 10
+#: owner sharding engages at this world size — below it the reduce-
+#: scatter/allgather restructuring saves too little memory to pay for
+#: losing replicated-state simplicity.
+OWNER_MIN_WORLD = 8
+
+# eigh slot padding defaults (ops/eigh.py bucket_size defaults, as used
+# by the chunk planners in parallel/assignment.py)
+_GRANULARITY = 512
+_MINIMUM = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFacts:
+    """What the cost model needs to know about a captured model.
+
+    ``shapes`` maps layer name → ``(g_side, a_side)`` exactly as
+    ``KFAC.init`` derives them (conv: ``a = cin·kh·kw + bias``, ``g =
+    cout``; dense: ``a = cin + bias``, ``g = cout``; embedding: ``a =
+    vocab`` but flagged in ``diag_a`` — its A factor is a diagonal
+    vector, not a matrix). Build from live params via
+    :func:`model_facts`, or literally for fixtures.
+    """
+
+    shapes: Dict[str, Tuple[int, int]]
+    diag_a: FrozenSet[str] = frozenset()
+    has_conv: bool = False
+
+    @property
+    def has_diag_a(self) -> bool:
+        return bool(self.diag_a)
+
+
+def model_facts(params, layers=None) -> ModelFacts:
+    """Derive :class:`ModelFacts` from a live params pytree.
+
+    Mirrors ``KFAC.init``'s factor-side derivation (preconditioner.py)
+    including grouped-conv pseudo-layers; kept in lockstep by
+    tests/test_planner.py's parity check against an initialized state.
+    """
+    from kfac_pytorch_tpu import capture
+
+    names = list(layers) if layers is not None else capture.layer_names(params)
+    gcounts = capture.group_counts(names)
+    shapes: Dict[str, Tuple[int, int]] = {}
+    diag_a = set()
+    has_conv = False
+    for name in names:
+        base, group_idx = capture.split_group_name(name)
+        node = params
+        for k in base.split("/"):
+            node = node[k]
+        if "embedding" in node:
+            vocab, feats = node["embedding"].shape
+            shapes[name] = (int(feats), int(vocab))
+            diag_a.add(name)
+            continue
+        kernel = node["kernel"]
+        has_bias = "bias" in node
+        if kernel.ndim == 4:
+            kh, kw, cin, cout = kernel.shape
+            if group_idx is not None:
+                cout = cout // gcounts[base]
+            shapes[name] = (int(cout), int(cin * kh * kw + int(has_bias)))
+            has_conv = True
+        else:
+            cin, cout = kernel.shape
+            shapes[name] = (int(cout), int(cin + int(has_bias)))
+    return ModelFacts(
+        shapes=shapes, diag_a=frozenset(diag_a), has_conv=has_conv
+    )
+
+
+def _rank_fn_for(plan: Plan):
+    """The size→rank policy a plan implies — same rule as
+    ``KFAC._rank_for`` so planner costs match runtime layouts."""
+    if plan.solver != "rsvd":
+        return None
+
+    def rank_for(n: int) -> Optional[int]:
+        if n < plan.solver_auto_threshold or plan.solver_rank >= n:
+            return None
+        return plan.solver_rank
+
+    return rank_for
+
+
+def _dense_sides(facts: ModelFacts):
+    """Every dense factor side the refresh decomposes: diag-A layers
+    contribute only their G side (the A refresh is elementwise)."""
+    sides = []
+    for name in sorted(facts.shapes):
+        g, a = facts.shapes[name]
+        if name not in facts.diag_a:
+            sides.append(a)
+        sides.append(g)
+    return sides
+
+
+def refresh_cost(facts: ModelFacts, plan: Plan) -> int:
+    """Total MAC cost of one curvature refresh under ``plan``'s solver."""
+    rank_fn = _rank_fn_for(plan)
+    return sum(
+        _slot_cost(n, _GRANULARITY, _MINIMUM, rank_fn)
+        for n in _dense_sides(facts)
+    )
+
+
+def precondition_cost(facts: ModelFacts) -> int:
+    """Every-step gradient-rotation MACs, summed over layers — the same
+    ``g²a + ga²`` (``g²a`` diag-A) count the LPT assignment balances."""
+    total = 0
+    for name, (g, a) in facts.shapes.items():
+        total += g * g * a if name in facts.diag_a else g * g * a + g * a * a
+    return total
+
+
+def wire_bytes_f32(facts: ModelFacts) -> Tuple[int, int]:
+    """(bytes per replica, bucket count) of one f32 factor exchange.
+
+    Leaf shapes match what the comm plane flattens: dense ``(a,a)`` +
+    ``(g,g)`` per layer, diag-A ``(a,)`` + ``(g,g)``; bucketed by the
+    plane's own ``plan_factor_buckets`` so the count is its collective
+    count.
+    """
+    leaf_shapes = []
+    for name in sorted(facts.shapes):
+        g, a = facts.shapes[name]
+        if name in facts.diag_a:
+            leaf_shapes.append((a,))
+        else:
+            leaf_shapes.append((a, a))
+        leaf_shapes.append((g, g))
+    buckets = plan_factor_buckets(leaf_shapes)
+    return sum(b.size for b in buckets) * 4, len(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class CostReport:
+    """The numbers behind a resolved plan — what the snapshot lint pins
+    and ``docs/PLANNER.md`` documents. All integer MACs/bytes except the
+    speedup ratio (rounded to 3 places for stable goldens)."""
+
+    world: int
+    layer_count: int
+    dense_side_count: int
+    max_side: int
+    refresh_cost_dense: int
+    refresh_cost_resolved: int
+    rsvd_speedup: float
+    precondition_cost: int
+    wire_bytes_f32: int
+    wire_bucket_count: int
+    owner_bytes_local: Optional[int]
+    owner_bytes_replicated: Optional[int]
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
+    """The profile="production" intent: every lever the model judges
+    profitable, before :func:`fit_plan` drops what the env refuses."""
+    sides = _dense_sides(facts)
+    max_side = max(sides) if sides else 0
+
+    # solver: truncate when it actually shrinks the refresh enough
+    candidate = Plan(
+        solver="rsvd",
+        solver_rank=RSVD_RANK,
+        solver_auto_threshold=RSVD_SIDE_THRESHOLD,
+    )
+    dense_cost = refresh_cost(facts, Plan())
+    rsvd_cost = refresh_cost(facts, candidate)
+    use_rsvd = (
+        max_side >= RSVD_SIDE_THRESHOLD
+        and rsvd_cost > 0
+        and dense_cost / rsvd_cost >= RSVD_MIN_SPEEDUP
+    )
+    plan = candidate if use_rsvd else Plan()
+
+    # chunks: spread the refresh spike until it is within budget of one
+    # step's precondition work (scheduler clamps k_eff to the refresh
+    # interval, so cap there too)
+    precond = precondition_cost(facts)
+    resolved_refresh = refresh_cost(facts, plan)
+    if precond > 0:
+        want = math.ceil(resolved_refresh / (CHUNK_SPIKE_BUDGET * precond))
+        chunks = max(1, min(want, MAX_CHUNKS, env.kfac_update_freq))
+    else:
+        chunks = 1
+    plan = dataclasses.replace(plan, eigh_chunks=chunks)
+
+    # wire: compress when the exchange is payload-bound; defer when there
+    # are enough capture steps per refresh to amortize over
+    if env.world > 1:
+        bytes_f32, _ = wire_bytes_f32(facts)
+        comm_dtype = "bf16" if bytes_f32 >= COMM_BF16_MIN_BYTES else "f32"
+        ratio = env.kfac_update_freq // max(1, env.fac_update_freq)
+        comm_freq = (
+            min(COMM_DEFER_FREQ, ratio)
+            if ratio >= COMM_DEFER_MIN_RATIO
+            else 1
+        )
+        plan = dataclasses.replace(
+            plan, factor_comm_dtype=comm_dtype, factor_comm_freq=comm_freq
+        )
+
+    # placement: owner-shard the curvature state at scale
+    if env.world >= OWNER_MIN_WORLD:
+        plan = dataclasses.replace(plan, factor_sharding="owner")
+
+    # kernel: pin the fused patch-covariance kernel where it is a fast
+    # path ("auto" already resolves to it on TPU; pinning records the
+    # decision in the plan so the snapshot shows it)
+    if facts.has_conv and env.on_tpu:
+        plan = dataclasses.replace(plan, factor_kernel="pallas")
+    return plan
+
+
+def _resolve_memory(facts: ModelFacts, env: PlanEnv) -> Plan:
+    """The profile="memory" intent: minimize per-device curvature bytes.
+
+    Owner sharding divides factor+eigen state by the owner count, the
+    truncated solver shrinks each eigenbasis from n² to n·r, and the
+    bf16 wire halves exchange payload. ``eigh_chunks`` stays 1 — the
+    pipelined refresh double-buffers the eigen state (eigen_pending),
+    the opposite of a memory win.
+    """
+    sides = _dense_sides(facts)
+    max_side = max(sides) if sides else 0
+    plan = Plan(
+        factor_sharding="owner" if env.world > 1 else "replicated",
+        factor_comm_dtype="bf16" if env.world > 1 else "f32",
+    )
+    if max_side >= RSVD_SIDE_THRESHOLD:
+        plan = dataclasses.replace(
+            plan,
+            solver="rsvd",
+            solver_rank=RSVD_RANK,
+            solver_auto_threshold=RSVD_SIDE_THRESHOLD,
+        )
+    return plan
+
+
+def resolve_profile(
+    profile: Union[str, Plan],
+    facts: Optional[ModelFacts],
+    env: PlanEnv,
+) -> Tuple[Plan, Optional[CostReport], Tuple[str, ...]]:
+    """Resolve a named profile (or fit an explicit plan) against an env.
+
+    Returns ``(plan, report, dropped)``: the valid plan, the cost numbers
+    it was derived from (``None`` when no shapes were available — then
+    only the world-size levers resolve), and the names of the validity
+    rules :func:`fit_plan` applied.
+    """
+    if isinstance(profile, Plan):
+        plan, dropped = fit_plan(profile, env)
+        report = _report(facts, env, plan) if facts is not None else None
+        return plan, report, dropped
+    if profile not in PROFILES:
+        raise ValueError(
+            f"unknown profile {profile!r}; expected one of "
+            f"{tuple(PROFILES)} or a planner.Plan"
+        )
+    if profile == "safe":
+        return Plan(), (
+            _report(facts, env, Plan()) if facts is not None else None
+        ), ()
+    if facts is None:
+        # No shapes: resolve only what the mesh alone decides. The
+        # shape-driven levers (solver, chunks, wire compression) stay at
+        # defaults rather than guessing.
+        intent = Plan(
+            factor_sharding=(
+                "owner"
+                if (
+                    profile == "memory"
+                    and env.world > 1
+                    or env.world >= OWNER_MIN_WORLD
+                )
+                else "replicated"
+            )
+        )
+        plan, dropped = fit_plan(intent, env)
+        return plan, None, dropped
+    intent = (
+        _resolve_memory(facts, env)
+        if profile == "memory"
+        else _resolve_production(facts, env)
+    )
+    plan, dropped = fit_plan(intent, env)
+    return plan, _report(facts, env, plan), dropped
+
+
+def _report(facts: ModelFacts, env: PlanEnv, plan: Plan) -> CostReport:
+    sides = _dense_sides(facts)
+    dense_cost = refresh_cost(facts, Plan())
+    resolved_cost = refresh_cost(facts, plan)
+    bytes_f32, buckets = wire_bytes_f32(facts)
+    owner_local = owner_repl = None
+    if plan.factor_sharding == "owner" and env.world > 1:
+        shard = plan_factor_shards(facts.shapes, env.world)
+        info = shard_plan_bytes(shard, rank_fn=_rank_fn_for(plan))
+        owner_local = int(info["total_buffer_local"])
+        owner_repl = int(info["replicated_total"])
+    return CostReport(
+        world=env.world,
+        layer_count=len(facts.shapes),
+        dense_side_count=len(sides),
+        max_side=max(sides) if sides else 0,
+        refresh_cost_dense=dense_cost,
+        refresh_cost_resolved=resolved_cost,
+        rsvd_speedup=round(dense_cost / resolved_cost, 3)
+        if resolved_cost
+        else 1.0,
+        precondition_cost=precondition_cost(facts),
+        wire_bytes_f32=bytes_f32,
+        wire_bucket_count=buckets,
+        owner_bytes_local=owner_local,
+        owner_bytes_replicated=owner_repl,
+    )

@@ -1,0 +1,405 @@
+"""Plan: one value per K-FAC perf lever, plus the composition validity matrix.
+
+PRs 2-6 each landed an orthogonal lever against the amortized K-FAC step
+overhead — ``eigh_chunks`` (pipelined refresh), ``factor_kernel`` (fused
+patch covariance), ``factor_comm_dtype``/``factor_comm_freq`` (wire
+compression / deferred reduction), ``solver``/``solver_rank`` (randomized
+low-rank refresh), ``factor_sharding`` (owner-sharded curvature state) —
+and each shipped its own refusal paths for the compositions it cannot run
+(owner sharding refuses the inverse method, rsvd refuses diag-blocks, the
+comm plane is inert without a multi-device mesh, ...). This module turns
+those scattered refusals into ONE declarative matrix:
+
+* :class:`Plan` — an immutable record of the six lever settings, the unit
+  the cost model resolves, the autotuner times, and ``KFAC(profile=...)``
+  consumes.
+* :class:`PlanEnv` — the non-lever context a plan must be valid against
+  (mesh shape, preconditioner method, model facts).
+* :data:`RULES` / :func:`violations` / :func:`fit_plan` — the validity
+  matrix itself. Every rule names the code that enforces it for real, so
+  tests can hold the matrix and the enforcement point to the same answer
+  (tests/test_planner.py's pairwise sweep does exactly that).
+
+Named profiles (the strings ``KFAC(profile=...)`` accepts) live here as
+declarative intents; the shape-aware resolution that turns an intent into
+a concrete :class:`Plan` is ``planner.cost_model.resolve_profile``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# The six lever fields and their bitwise-inert defaults — must mirror the
+# KFAC constructor defaults exactly (preconditioner.py); test_planner.py
+# pins the correspondence.
+LEVER_FIELDS = (
+    "eigh_chunks",
+    "factor_kernel",
+    "factor_comm_dtype",
+    "factor_comm_freq",
+    "solver",
+    "solver_rank",
+    "solver_auto_threshold",
+    "factor_sharding",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One concrete composition of the six K-FAC perf levers.
+
+    All defaults are the bitwise-inert values: a default ``Plan()`` run
+    through ``KFAC(profile=Plan())`` configures exactly what ``KFAC()``
+    does today. ``solver_rank``/``solver_auto_threshold`` only matter when
+    ``solver="rsvd"`` (they mirror the constructor args of the same name).
+    """
+
+    eigh_chunks: int = 1
+    factor_kernel: str = "auto"
+    factor_comm_dtype: str = "f32"
+    factor_comm_freq: int = 1
+    solver: str = "eigh"
+    solver_rank: int = 128
+    solver_auto_threshold: int = 512
+    factor_sharding: str = "replicated"
+
+    def kfac_kwargs(self) -> Dict[str, object]:
+        """The KFAC constructor kwargs this plan pins."""
+        return {f: getattr(self, f) for f in LEVER_FIELDS}
+
+    def non_default_levers(self) -> Tuple[str, ...]:
+        """Lever names set away from their bitwise-inert defaults.
+
+        ``solver_rank``/``solver_auto_threshold`` count only when the rsvd
+        solver is actually on, and ``factor_kernel`` counts only when
+        pinned away from ``auto`` — matching what changes the compiled
+        program.
+        """
+        default = Plan()
+        out = []
+        for f in ("eigh_chunks", "factor_kernel", "factor_comm_dtype",
+                  "factor_comm_freq", "solver", "factor_sharding"):
+            if getattr(self, f) != getattr(default, f):
+                out.append(f)
+        return tuple(out)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {f: getattr(self, f) for f in LEVER_FIELDS}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Plan":
+        unknown = set(d) - set(LEVER_FIELDS)
+        if unknown:
+            raise ValueError(f"unknown Plan fields: {sorted(unknown)}")
+        kwargs = dict(d)
+        for f in ("eigh_chunks", "factor_comm_freq", "solver_rank",
+                  "solver_auto_threshold"):
+            if f in kwargs:
+                kwargs[f] = int(kwargs[f])
+        return cls(**kwargs)
+
+    # -- checkpoint form --------------------------------------------------
+    # Orbax round-trips array pytrees; strings do not survive as leaves.
+    # Encode the categorical levers as small int arrays so a resolved plan
+    # can ride inside a checkpoint directory and be reconstructed exactly
+    # (training/checkpoint.py; tests/test_planner.py pins the round-trip).
+
+    _KERNELS = ("auto", "pallas", "dense")
+    _COMM_DTYPES = ("f32", "bf16")
+    _SOLVERS = ("eigh", "rsvd")
+    _SHARDINGS = ("replicated", "owner")
+
+    def to_state(self) -> Dict[str, np.ndarray]:
+        """Array-leaved pytree form (checkpointable via orbax)."""
+        enc = {
+            "eigh_chunks": self.eigh_chunks,
+            "factor_kernel": self._KERNELS.index(self.factor_kernel),
+            "factor_comm_dtype": self._COMM_DTYPES.index(self.factor_comm_dtype),
+            "factor_comm_freq": self.factor_comm_freq,
+            "solver": self._SOLVERS.index(self.solver),
+            "solver_rank": self.solver_rank,
+            "solver_auto_threshold": self.solver_auto_threshold,
+            "factor_sharding": self._SHARDINGS.index(self.factor_sharding),
+        }
+        return {k: np.asarray(v, np.int32) for k, v in enc.items()}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, np.ndarray]) -> "Plan":
+        g = {k: int(np.asarray(v)) for k, v in state.items()}
+        return cls(
+            eigh_chunks=g["eigh_chunks"],
+            factor_kernel=cls._KERNELS[g["factor_kernel"]],
+            factor_comm_dtype=cls._COMM_DTYPES[g["factor_comm_dtype"]],
+            factor_comm_freq=g["factor_comm_freq"],
+            solver=cls._SOLVERS[g["solver"]],
+            solver_rank=g["solver_rank"],
+            solver_auto_threshold=g["solver_auto_threshold"],
+            factor_sharding=cls._SHARDINGS[g["factor_sharding"]],
+        )
+
+    def describe(self) -> str:
+        """One-line human summary (trainer startup banners)."""
+        on = self.non_default_levers()
+        if not on:
+            return "plan: all levers at bitwise-inert defaults"
+        bits = []
+        if "eigh_chunks" in on:
+            bits.append(f"eigh_chunks={self.eigh_chunks}")
+        if "factor_kernel" in on:
+            bits.append(f"factor_kernel={self.factor_kernel}")
+        if "factor_comm_dtype" in on:
+            bits.append(f"factor_comm_dtype={self.factor_comm_dtype}")
+        if "factor_comm_freq" in on:
+            bits.append(f"factor_comm_freq={self.factor_comm_freq}")
+        if "solver" in on:
+            bits.append(
+                f"solver=rsvd(rank={self.solver_rank},"
+                f"threshold={self.solver_auto_threshold})"
+            )
+        if "factor_sharding" in on:
+            bits.append("factor_sharding=owner")
+        return "plan: " + " ".join(bits)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanEnv:
+    """Everything a plan's validity and cost depend on besides the levers.
+
+    ``mesh_axes`` is the KFAC mesh's axis-name tuple (empty when no mesh);
+    ``world`` its total device count (1 without a mesh). The model facts
+    (``has_diag_a_layers``: any embedding/diagonal-A layer captured;
+    ``has_conv_layers``: any conv layer) gate the levers whose refusals
+    fire at ``init(params)`` rather than construction. ``on_tpu`` gates
+    pinning the Pallas factor kernel (elsewhere it only runs in interpret
+    mode, a test vehicle, not a fast path).
+    """
+
+    world: int = 1
+    mesh_axes: Tuple[str, ...] = ()
+    precond_method: str = "eigen"
+    diag_blocks: int = 1
+    distribute_precondition: bool = False
+    track_diagnostics: bool = False
+    has_diag_a_layers: bool = False
+    has_conv_layers: bool = True
+    on_tpu: bool = False
+    fac_update_freq: int = 10
+    kfac_update_freq: int = 100
+
+    @property
+    def multi_device(self) -> bool:
+        return self.world > 1
+
+    @property
+    def pure_dp(self) -> bool:
+        """Single-axis (or no) mesh — what the explicit-collective comm
+        wrappers require (training/step.py::require_pure_dp_mesh)."""
+        return len(self.mesh_axes) <= 1
+
+
+def _comm_active(plan: Plan) -> bool:
+    return plan.factor_comm_dtype != "f32" or plan.factor_comm_freq > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One row of the composition validity matrix.
+
+    ``applies`` — does the plan engage the lever this rule guards;
+    ``conflicts`` — does the environment (or another lever) refuse it;
+    ``drop`` — lever field(s) :func:`fit_plan` clears to satisfy the rule;
+    ``enforced_by`` — where the real refusal lives (``"constructor"`` =
+    ``KFAC.__init__`` raises, ``"init"`` = ``KFAC.init(params)`` raises,
+    ``"train_step"`` = the training wrapper / CLI guard refuses,
+    ``"degrade"`` = warn-and-ignore rather than raise).
+    """
+
+    name: str
+    applies: Callable[[Plan], bool]
+    conflicts: Callable[[Plan, PlanEnv], bool]
+    drop: Tuple[str, ...]
+    enforced_by: str
+    message: str
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule(
+        name="chunks_vs_inverse",
+        applies=lambda p: p.eigh_chunks > 1,
+        conflicts=lambda p, e: e.precond_method == "inverse",
+        drop=("eigh_chunks",),
+        enforced_by="constructor",
+        message="eigh_chunks > 1 pipelines the eigendecomposition refresh; "
+                "precond_method='inverse' has no eigh spike to spread",
+    ),
+    Rule(
+        name="rsvd_vs_inverse",
+        applies=lambda p: p.solver == "rsvd",
+        conflicts=lambda p, e: e.precond_method == "inverse",
+        drop=("solver",),
+        enforced_by="constructor",
+        message="solver='rsvd' feeds the eigenbasis (Woodbury) apply path; "
+                "precond_method='inverse' would silently ignore it",
+    ),
+    Rule(
+        name="rsvd_vs_diag_blocks",
+        applies=lambda p: p.solver == "rsvd",
+        conflicts=lambda p, e: e.diag_blocks > 1,
+        drop=("solver",),
+        enforced_by="constructor",
+        message="solver='rsvd' stores one truncated basis per whole factor; "
+                "diag_blocks > 1 carves factors into blocks",
+    ),
+    Rule(
+        name="owner_vs_inverse",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.precond_method != "eigen",
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="factor_sharding='owner' shards eigenbasis state; "
+                "precond_method='inverse' keeps Cholesky inverses it does "
+                "not lay out",
+    ),
+    Rule(
+        name="owner_vs_diag_blocks",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.diag_blocks > 1,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="factor_sharding='owner' stores one whole-factor slot per "
+                "(layer, side); diag_blocks > 1 has its own owner table",
+    ),
+    Rule(
+        name="owner_vs_distribute_precondition",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.distribute_precondition,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="factor_sharding='owner' already preconditions each layer "
+                "on its owner; distribute_precondition would layer a second "
+                "owner table on top",
+    ),
+    Rule(
+        name="owner_vs_diagnostics",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.track_diagnostics,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="factor_sharding='owner' keeps no replicated per-layer "
+                "spectra for the diagnostics pytree to read",
+    ),
+    Rule(
+        name="owner_vs_multi_axis_mesh",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.multi_device and not e.pure_dp,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="factor_sharding='owner' requires a pure data-parallel "
+                "mesh (one axis)",
+    ),
+    Rule(
+        name="owner_vs_diag_a_layers",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.has_diag_a_layers,
+        drop=("factor_sharding",),
+        enforced_by="init",
+        message="factor_sharding='owner' does not support diagonal-A "
+                "(embedding) layers — no dense A factor to shard",
+    ),
+    Rule(
+        name="comm_vs_multi_axis_mesh",
+        applies=_comm_active,
+        conflicts=lambda p, e: e.multi_device and not e.pure_dp,
+        drop=("factor_comm_dtype", "factor_comm_freq"),
+        enforced_by="train_step",
+        message="factor_comm_dtype/factor_comm_freq ride the explicit "
+                "pure-data-parallel collective wrapper (training/step.py "
+                "require_pure_dp_mesh); a multi-axis mesh cannot use them",
+    ),
+    # Degrade rules: not refusals — the constructor warns and runs with the
+    # lever inert — but a RESOLVED plan should not carry dead levers, so
+    # fit_plan clears them too (and reports them as dropped).
+    Rule(
+        name="owner_vs_single_device",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: not e.multi_device,
+        drop=("factor_sharding",),
+        enforced_by="degrade",
+        message="factor_sharding='owner' has no effect without a "
+                "multi-device mesh — factor state stays replicated",
+    ),
+    Rule(
+        name="comm_vs_single_device",
+        applies=_comm_active,
+        conflicts=lambda p, e: not e.multi_device,
+        drop=("factor_comm_dtype", "factor_comm_freq"),
+        enforced_by="degrade",
+        message="factor_comm_dtype/factor_comm_freq shape a cross-replica "
+                "exchange that does not exist without a multi-device mesh",
+    ),
+)
+
+# Rules whose real enforcement raises (vs warns): the set the pairwise
+# matrix test checks against actual KFAC construction / init behavior.
+REFUSAL_RULES = tuple(r for r in RULES if r.enforced_by != "degrade")
+
+
+def violations(plan: Plan, env: PlanEnv,
+               include_degrades: bool = False) -> List[Rule]:
+    """Rules this (plan, env) pair trips, in matrix order."""
+    rules = RULES if include_degrades else REFUSAL_RULES
+    return [r for r in rules if r.applies(plan) and r.conflicts(plan, env)]
+
+
+def check_plan(plan: Plan, env: PlanEnv) -> None:
+    """Raise ``ValueError`` listing every refusal this plan would hit."""
+    bad = violations(plan, env)
+    if bad:
+        lines = "; ".join(f"[{r.name}] {r.message}" for r in bad)
+        raise ValueError(f"invalid lever composition: {lines}")
+
+
+def fit_plan(plan: Plan, env: PlanEnv) -> Tuple[Plan, Tuple[str, ...]]:
+    """Clear every lever the environment refuses (or would silently
+    ignore); returns the valid plan plus the names of the rules applied.
+
+    Deterministic: rules apply in matrix order, and clearing a lever means
+    resetting its field(s) to the ``Plan()`` defaults — so the result is a
+    pure function of (plan, env) and every host derives the same one.
+    """
+    default = Plan()
+    dropped: List[str] = []
+    current = plan
+    for rule in RULES:
+        if rule.applies(current) and rule.conflicts(current, env):
+            current = dataclasses.replace(
+                current, **{f: getattr(default, f) for f in rule.drop}
+            )
+            dropped.append(rule.name)
+    return current, tuple(dropped)
+
+
+# ---------------------------------------------------------------------------
+# Named profiles
+# ---------------------------------------------------------------------------
+
+#: The strings ``KFAC(profile=...)`` accepts. Values are intents — which
+#: levers the profile WANTS engaged; ``cost_model.resolve_profile`` turns
+#: an intent into a concrete :class:`Plan` using the layer shapes and the
+#: environment, then :func:`fit_plan` drops whatever the environment
+#: refuses.
+PROFILES: Dict[str, str] = {
+    "safe": "all levers at bitwise-inert defaults (reference parity)",
+    "memory": "minimize per-device curvature memory: owner-sharded state, "
+              "truncated solver, compressed wire; no refresh pipelining "
+              "(the double buffer costs memory)",
+    "production": "minimize amortized step overhead: every lever the cost "
+                  "model judges profitable for this model and mesh",
+}
+
+
+def profile_names() -> Tuple[str, ...]:
+    return tuple(PROFILES)

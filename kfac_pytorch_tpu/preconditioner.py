@@ -149,6 +149,8 @@ class KFAC:
         solver_rank: int = 128,
         solver_auto_threshold: int = 512,
         factor_sharding: str = "replicated",
+        profile: Optional[Any] = None,
+        profile_shapes: Optional[Any] = None,
     ):
         _validate("learning rate", 0.0 <= lr, lr)
         _validate("factor decay rate", 0.0 < factor_decay <= 1, factor_decay)
@@ -255,6 +257,98 @@ class KFAC:
                 "block-diagonal approximation"
             )
         self.precond_method = precond_method
+        # planner/ entry point: profile=None is the bitwise-inert default —
+        # the planner package is not even imported, and every lever below
+        # keeps exactly the value (explicit or default) the caller passed.
+        # A profile name ("production"/"memory"/"safe") or a planner.Plan
+        # resolves/validates against this constructor's environment and
+        # fills in ONLY the lever arguments the caller left at their
+        # defaults — an explicit lever always wins over the plan, so a
+        # profile is a starting point, not a straitjacket (docs/PLANNER.md).
+        self.plan = None
+        self.plan_dropped: Tuple[str, ...] = ()
+        self.plan_report = None
+        self.plan_env = None
+        if profile is not None:
+            from kfac_pytorch_tpu import planner as _planner
+
+            facts = profile_shapes
+            if facts is not None and not isinstance(facts, _planner.ModelFacts):
+                d = dict(facts)
+                if d and all(
+                    isinstance(v, (tuple, list)) and len(v) == 2
+                    and all(isinstance(s, (int, np.integer)) for s in v)
+                    for v in d.values()
+                ):
+                    # plain {layer: (g_side, a_side)} shape dict
+                    facts = _planner.ModelFacts(
+                        shapes={k: (int(g), int(a)) for k, (g, a) in d.items()}
+                    )
+                else:
+                    # live params pytree — derive sides the same way init
+                    # will, honoring the captured layer list
+                    facts = _planner.model_facts(
+                        profile_shapes, layers=self.layers
+                    )
+            env = _planner.PlanEnv(
+                world=1 if mesh is None else int(mesh.devices.size),
+                mesh_axes=()
+                if mesh is None
+                else tuple(str(a) for a in mesh.axis_names),
+                precond_method=precond_method,
+                diag_blocks=diag_blocks,
+                distribute_precondition=distribute_precondition,
+                track_diagnostics=track_diagnostics,
+                has_diag_a_layers=(
+                    facts.has_diag_a if facts is not None else False
+                ),
+                has_conv_layers=(
+                    facts.has_conv if facts is not None else True
+                ),
+                on_tpu=jax.default_backend() == "tpu",
+                fac_update_freq=fac_update_freq,
+                kfac_update_freq=kfac_update_freq,
+            )
+            if isinstance(profile, _planner.Plan):
+                # An explicit plan must be valid as given (refusals raise
+                # here with the matrix's reasons); the degrade rules then
+                # normalize it — e.g. owner sharding on a 1-device dev run
+                # resolves to replicated, same as the constructor warning
+                # path would.
+                _planner.check_plan(profile, env)
+                plan, dropped = _planner.fit_plan(profile, env)
+                report = None
+            else:
+                plan, report, dropped = _planner.resolve_profile(
+                    profile, facts, env
+                )
+            plan_defaults = _planner.Plan()
+            levers = {
+                "eigh_chunks": eigh_chunks,
+                "factor_kernel": factor_kernel,
+                "factor_comm_dtype": factor_comm_dtype,
+                "factor_comm_freq": factor_comm_freq,
+                "solver": solver,
+                "solver_rank": solver_rank,
+                "solver_auto_threshold": solver_auto_threshold,
+                "factor_sharding": factor_sharding,
+            }
+            for field, value in plan.kfac_kwargs().items():
+                if levers[field] == getattr(plan_defaults, field):
+                    levers[field] = value
+            eigh_chunks = levers["eigh_chunks"]
+            factor_kernel = levers["factor_kernel"]
+            factor_comm_dtype = levers["factor_comm_dtype"]
+            factor_comm_freq = levers["factor_comm_freq"]
+            solver = levers["solver"]
+            solver_rank = levers["solver_rank"]
+            solver_auto_threshold = levers["solver_auto_threshold"]
+            factor_sharding = levers["factor_sharding"]
+            self.plan = plan
+            self.plan_dropped = tuple(dropped)
+            self.plan_report = report
+            self.plan_env = env
+            _planner.log_plan(plan, dropped)
         # Pipelined curvature refresh: split the eigen refresh into this many
         # static chunks spread over the steps after each kfac_update_freq
         # boundary, double-buffered in state["eigen_pending"] and swapped in

@@ -337,10 +337,18 @@ def test_update_validates_flush():
 
 def test_expected_step_variants_deferred():
     assert expected_step_variants(KFAC(damping=0.01)) == 3
+    # defer splits the factor step by the flush flag: plain,
+    # factors±flush, eigen(+flush)
     assert expected_step_variants(_mesh_kfac(factor_comm_freq=2)) == 4
+    # exact cadence replay, not the old 3 + 2K bound (which said 9):
+    # plain, factors-only, bootstrap, chunk0±factors, chunk1, chunk2
+    # ±factors — chunk1 never coincides with a fac_update_freq step
+    # (s ≡ 1 mod 6 and s ≡ 0 mod 10 has no solution)
     assert expected_step_variants(
         KFAC(damping=0.01, eigh_chunks=3, kfac_update_freq=6)
-    ) == 9
+    ) == 8
+    # composing defer on top adds only the flush twins the schedule can
+    # actually produce (old per-lever bound said 11)
     assert expected_step_variants(
         _mesh_kfac(eigh_chunks=3, kfac_update_freq=6, factor_comm_freq=2)
-    ) == 11
+    ) == 10

@@ -1,0 +1,499 @@
+"""planner/ contracts: cost-model monotonicity, the composition validity
+matrix vs the REAL refusal behavior, profile inertness, autotune
+determinism, plan checkpointing, and the exact compile budget.
+
+The matrix test is the load-bearing one: profiles.RULES claims to encode
+every refusal path the six levers introduced, and the only way that claim
+stays true is to hold the matrix and the enforcement points
+(KFAC.__init__ / KFAC.init / training.step.require_pure_dp_mesh) to the
+same answer for every (lever, environment) pair — both directions: every
+predicted violation actually refuses, and every predicted-valid pair
+actually constructs.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kfac_pytorch_tpu import KFAC, capture
+from kfac_pytorch_tpu.compile_cache import expected_step_variants
+from kfac_pytorch_tpu.models.layers import KFACDense, KFACEmbed
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.planner import (
+    ModelFacts,
+    Plan,
+    PlanEnv,
+    autotune,
+    candidate_plans,
+    model_facts,
+    resolve_profile,
+    violations,
+)
+from kfac_pytorch_tpu.planner.profiles import REFUSAL_RULES, fit_plan
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    make_sgd,
+    make_train_step,
+    require_pure_dp_mesh,
+)
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+# all factor sides < 512: the truncated solver must never engage
+_SMALL_FACTS = ModelFacts(
+    shapes={f"conv{i}": (64, 288) for i in range(12)}, has_conv=True
+)
+# CIFAR-ResNet-like: 576-wide A sides — a big refresh relative to the
+# every-step rotation work, but not enough rsvd speedup to truncate
+_MEDIUM_FACTS = ModelFacts(
+    shapes={f"conv{i}": (64, 576) for i in range(30)}, has_conv=True
+)
+# ResNet-50-like: 2304/4608-wide sides where truncation wins big
+_BIG_FACTS = ModelFacts(
+    shapes={
+        **{f"mid{i}": (256, 2304) for i in range(6)},
+        **{f"deep{i}": (512, 4608) for i in range(3)},
+        "fc": (1000, 2049),
+    },
+    has_conv=True,
+)
+
+
+def _env(world=8, axes=("data",), **kw):
+    return PlanEnv(world=world, mesh_axes=axes if world > 1 else (), **kw)
+
+
+# ---------------------------------------------------------------------------
+# cost-model monotonicity
+# ---------------------------------------------------------------------------
+
+
+def test_bigger_sides_engage_rsvd():
+    env = _env(world=8, on_tpu=True)
+    small, _, _ = resolve_profile("production", _SMALL_FACTS, env)
+    big, report, _ = resolve_profile("production", _BIG_FACTS, env)
+    assert small.solver == "eigh"
+    assert big.solver == "rsvd"
+    assert report.rsvd_speedup >= 2.0
+
+
+def test_more_devices_engage_owner_monotonically():
+    """Once the world is big enough for owner sharding, every bigger
+    world keeps it — the lever must be monotone in device count."""
+    engaged = [
+        resolve_profile(
+            "production", _BIG_FACTS, _env(world=w)
+        )[0].factor_sharding
+        == "owner"
+        for w in (1, 2, 4, 8, 16, 32, 64)
+    ]
+    assert engaged == sorted(engaged)  # False... then True...
+    assert engaged[-1] and not engaged[0]
+
+
+def test_refresh_heavy_models_chunk_the_refresh():
+    env = _env(world=8)
+    small, _, _ = resolve_profile("production", _SMALL_FACTS, env)
+    medium, _, _ = resolve_profile("production", _MEDIUM_FACTS, env)
+    assert small.eigh_chunks == 1
+    assert medium.eigh_chunks > 1
+    # the scheduler clamps k_eff to the refresh interval; the plan must too
+    tight, _, _ = resolve_profile(
+        "production", _MEDIUM_FACTS, _env(world=8, kfac_update_freq=1)
+    )
+    assert tight.eigh_chunks == 1
+
+
+def test_memory_profile_never_chunks():
+    """eigh_chunks>1 double-buffers the eigen state (eigen_pending) — the
+    opposite of a memory win — so the memory profile must keep it off."""
+    for facts in (_SMALL_FACTS, _BIG_FACTS):
+        plan, _, _ = resolve_profile("memory", facts, _env(world=8))
+        assert plan.eigh_chunks == 1
+        assert plan.factor_sharding == "owner"
+
+
+def test_production_resolves_composed_plan_at_scale():
+    """The acceptance bar: ≥3 non-default levers on big shapes at world
+    32 (the exact ResNet-50 plan is pinned by check_plan_snapshot.py)."""
+    plan, _, dropped = resolve_profile(
+        "production", _BIG_FACTS, _env(world=32, on_tpu=True)
+    )
+    assert len(plan.non_default_levers()) >= 3
+    assert not dropped
+
+
+def test_model_facts_matches_init_factor_shapes():
+    """model_facts must derive the SAME (g, a) sides init() builds
+    factors with — the cost model prices what the runtime allocates."""
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(8, (3, 3), name="plain_conv")(x)  # not captured
+            from kfac_pytorch_tpu.models.layers import KFACConv
+
+            x = KFACConv(8, (3, 3), name="conv")(x)
+            x = x.reshape((x.shape[0], -1))
+            return KFACDense(10, name="fc")(x)
+
+    params = Net().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=True
+    )["params"]
+    facts = model_facts(params)
+    kfac = KFAC(damping=0.01)
+    state = kfac.init(params)
+    init_shapes = {
+        name: (int(f["G"].shape[0]), int(f["A"].shape[0]))
+        for name, f in state["factors"].items()
+    }
+    assert facts.shapes == init_shapes
+    assert facts.has_conv and not facts.has_diag_a
+
+
+# ---------------------------------------------------------------------------
+# pairwise composition-validity matrix vs the real refusals
+# ---------------------------------------------------------------------------
+
+_LEVERS = {
+    "chunks": Plan(eigh_chunks=2),
+    "kernel": Plan(factor_kernel="pallas"),
+    "comm_dtype": Plan(factor_comm_dtype="bf16"),
+    "comm_freq": Plan(factor_comm_freq=2),
+    "rsvd": Plan(solver="rsvd"),
+    "owner": Plan(factor_sharding="owner"),
+    "owner+chunks": Plan(factor_sharding="owner", eigh_chunks=2),
+    "rsvd+comm": Plan(solver="rsvd", factor_comm_dtype="bf16"),
+}
+
+# environment features, each mapping to (PlanEnv kwargs, KFAC kwargs)
+_ENVS = {
+    "default_dp8": (dict(), dict()),
+    "inverse": (dict(precond_method="inverse"), dict(precond_method="inverse")),
+    "diag_blocks": (dict(diag_blocks=2), dict(diag_blocks=2)),
+    "dist_precond": (
+        dict(distribute_precondition=True),
+        dict(distribute_precondition=True),
+    ),
+    "diagnostics": (
+        dict(track_diagnostics=True),
+        dict(track_diagnostics=True),
+    ),
+    "multi_axis": (dict(axes=("data", "seq")), dict()),
+    "single_device": (dict(world=1), dict()),
+}
+
+
+def _mesh_for(env_name):
+    if env_name == "single_device":
+        return None
+    devices = np.asarray(jax.devices())
+    if env_name == "multi_axis":
+        return Mesh(devices.reshape(4, 2), ("data", "seq"))
+    return data_parallel_mesh()
+
+
+@pytest.mark.parametrize("lever_name", sorted(_LEVERS))
+@pytest.mark.parametrize("env_name", sorted(_ENVS))
+def test_validity_matrix_matches_constructor(lever_name, env_name):
+    """Both directions, every pair: constructor-enforced rules the matrix
+    predicts must raise ValueError, and pairs the matrix calls valid (or
+    merely degrade / init- / train-step-enforced) must construct."""
+    plan = _LEVERS[lever_name]
+    env_kw, kfac_kw = _ENVS[env_name]
+    env_kw = dict(env_kw)
+    axes = env_kw.pop("axes", ("data",))
+    world = env_kw.pop("world", 8)
+    env = PlanEnv(
+        world=world, mesh_axes=axes if world > 1 else (), **env_kw
+    )
+    bad = violations(plan, env)
+    mesh = _mesh_for(env_name)
+    construct = lambda: KFAC(  # noqa: E731
+        damping=0.01, mesh=mesh, **kfac_kw, **plan.kfac_kwargs()
+    )
+    constructor_rules = [r for r in bad if r.enforced_by == "constructor"]
+    if constructor_rules:
+        with pytest.raises(ValueError):
+            construct()
+        return
+    kfac = construct()
+    # train-step-enforced: the comm levers on a multi-axis mesh construct
+    # fine but the explicit-collective wrapper refuses the mesh
+    if any(r.enforced_by == "train_step" for r in bad):
+        with pytest.raises(ValueError, match="pure data-parallel"):
+            require_pure_dp_mesh(kfac.mesh)
+
+
+def test_matrix_grid_exercises_every_refusal_rule():
+    """Completeness: the pairwise grid above must trip every refusal rule
+    at least once except the init-time diag-A rule (covered separately) —
+    otherwise the matrix has rows no test holds to reality."""
+    tripped = set()
+    for plan in _LEVERS.values():
+        for env_kw, _ in _ENVS.values():
+            env_kw = dict(env_kw)
+            axes = env_kw.pop("axes", ("data",))
+            world = env_kw.pop("world", 8)
+            env = PlanEnv(
+                world=world, mesh_axes=axes if world > 1 else (), **env_kw
+            )
+            tripped |= {r.name for r in violations(plan, env)}
+    expected = {r.name for r in REFUSAL_RULES} - {"owner_vs_diag_a_layers"}
+    assert expected <= tripped, expected - tripped
+
+
+def test_owner_diag_a_rule_matches_init_refusal():
+    """The one init()-enforced rule: predicted by the matrix from model
+    facts, actually raised by KFAC.init on an embedding model."""
+
+    class EmbedNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = KFACEmbed(16, 8, name="emb")(x)
+            return KFACDense(4, name="fc")(x.mean(axis=1))
+
+    toks = jnp.zeros((2, 3), jnp.int32)
+    model = EmbedNet()
+    params = model.init(jax.random.PRNGKey(0), toks, train=True)["params"]
+    # embeddings are only captured when explicitly discovered (the LM
+    # trainer's path) — the default layer set excludes them
+    from kfac_pytorch_tpu import capture
+
+    layers = capture.discover_layers(model, toks, train=False)
+    facts = model_facts(params, layers=layers)
+    assert facts.has_diag_a
+    env = _env(world=8, has_diag_a_layers=True)
+    bad = violations(Plan(factor_sharding="owner"), env)
+    assert [r.name for r in bad] == ["owner_vs_diag_a_layers"]
+    assert all(r.enforced_by == "init" for r in bad)
+    kfac = KFAC(
+        damping=0.01, mesh=data_parallel_mesh(), factor_sharding="owner",
+        layers=layers,
+    )
+    with pytest.raises(ValueError, match="diagonal-A"):
+        kfac.init(params)
+    # and fit_plan resolves it the way resolve_profile would: drop owner
+    fitted, dropped = fit_plan(Plan(factor_sharding="owner"), env)
+    assert fitted.factor_sharding == "replicated"
+    assert "owner_vs_diag_a_layers" in dropped
+
+
+def test_degrade_rules_match_constructor_warnings():
+    """Degrade rows (not refusals): the constructor accepts and runs
+    inert; fit_plan must clear the same levers so resolved plans never
+    carry dead configuration."""
+    env = _env(world=1)
+    plan = Plan(
+        factor_sharding="owner", factor_comm_dtype="bf16", factor_comm_freq=2
+    )
+    assert not violations(plan, env)  # no refusal...
+    fitted, dropped = fit_plan(plan, env)
+    assert fitted == Plan()  # ...but nothing survives on one device
+    assert set(dropped) == {"owner_vs_single_device", "comm_vs_single_device"}
+    kfac = KFAC(damping=0.01, **plan.kfac_kwargs())  # warns, constructs
+    assert kfac.factor_sharding == "replicated"
+
+
+# ---------------------------------------------------------------------------
+# profile wiring in the constructor
+# ---------------------------------------------------------------------------
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(KFACDense(16, name="fc1")(x))
+        return KFACDense(10, name="fc2")(x)
+
+
+def _lowered_text(kfac):
+    model = _MLP()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(8, 4, 3).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=8))
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    return fn.lower(
+        state, (x, y), jnp.float32(0.1), jnp.float32(0.01),
+        update_factors=True, update_eigen=True,
+    ).as_text()
+
+
+def test_profile_none_and_safe_are_inert():
+    """profile=None and profile="safe" must lower to a program identical
+    to today's default construction — the planner costs nothing unless
+    levers actually engage."""
+    base = _lowered_text(KFAC(damping=0.01))
+    assert _lowered_text(KFAC(damping=0.01, profile=None)) == base
+    assert _lowered_text(KFAC(damping=0.01, profile="safe")) == base
+
+
+def test_profile_fills_only_default_levers():
+    facts = _BIG_FACTS
+    k = KFAC(damping=0.01, profile="production", profile_shapes=facts)
+    assert k.solver == "rsvd"  # plan filled it
+    # explicit non-default lever wins over the plan's choice
+    k2 = KFAC(
+        damping=0.01, profile="production", profile_shapes=facts,
+        solver_rank=64,
+    )
+    assert k2.solver_rank == 64
+    assert k2.plan is not None and k2.plan.solver == "rsvd"
+
+
+def test_profile_accepts_plain_shape_dict():
+    k = KFAC(
+        damping=0.01, profile="production",
+        profile_shapes={f"l{i}": (512, 4608) for i in range(6)},
+    )
+    assert k.solver == "rsvd"
+
+
+def test_profile_accepts_raw_params_pytree():
+    # the constructor must derive facts from a live params tree itself
+    # (docs/PLANNER.md promises it) instead of misreading it as a shape dict
+    model = _MLP()
+    x = jnp.ones((4, 8), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    k = KFAC(
+        layers=capture.layer_names(params), damping=0.01,
+        profile="production", profile_shapes=params,
+    )
+    assert k.plan is not None
+    facts = model_facts(params, layers=capture.layer_names(params))
+    k2 = KFAC(
+        layers=capture.layer_names(params), damping=0.01,
+        profile="production", profile_shapes=facts,
+    )
+    assert k.plan == k2.plan
+
+
+def test_explicit_plan_checked_against_env():
+    with pytest.raises(ValueError, match="rsvd_vs_diag_blocks"):
+        KFAC(damping=0.01, diag_blocks=2, profile=Plan(solver="rsvd"))
+    k = KFAC(damping=0.01, profile=Plan(solver="rsvd", solver_rank=96))
+    assert k.solver == "rsvd" and k.solver_rank == 96
+    assert k.plan.solver_rank == 96
+
+
+def test_unknown_profile_refused():
+    with pytest.raises(ValueError, match="unknown profile"):
+        KFAC(damping=0.01, profile="turbo")
+
+
+# ---------------------------------------------------------------------------
+# autotune
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_deterministic_under_fixed_timings():
+    env = _env(world=8, on_tpu=True)
+    plan, _, _ = resolve_profile("production", _BIG_FACTS, env)
+    cands = candidate_plans(plan, env)
+    assert 2 <= len(cands) <= 3
+    assert cands[0] == plan and cands[-1] == Plan()
+
+    timings = {c: 1.0 + 0.1 * i for i, c in enumerate(cands)}
+    reports = [
+        autotune(cands, lambda p, s: timings[p], steps=2) for _ in range(3)
+    ]
+    assert all(r.winner_index == 0 for r in reports)
+    assert all(r.winner == plan for r in reports)
+    # ties break toward the earlier candidate (the cost model's pick)
+    tied = autotune(cands, lambda p, s: 1.0, steps=2)
+    assert tied.winner_index == 0
+    # and a faster fallback actually wins
+    flipped = autotune(
+        cands, lambda p, s: 0.5 if p == Plan() else 1.0, steps=2
+    )
+    assert flipped.winner == Plan()
+
+
+def test_candidate_plans_dedupe_to_one_when_safe():
+    env = _env(world=1)
+    assert candidate_plans(Plan(), env) == [Plan()]
+
+
+# ---------------------------------------------------------------------------
+# plan round-trip through training/checkpoint.py
+# ---------------------------------------------------------------------------
+
+
+def test_plan_round_trips_through_checkpoint(tmp_path):
+    from kfac_pytorch_tpu.training import checkpoint as ckpt
+
+    plan, _, _ = resolve_profile(
+        "production", _BIG_FACTS, _env(world=32, on_tpu=True)
+    )
+    assert plan != Plan()
+    payload = {"plan": plan.to_state(), "epoch": np.asarray(3, np.int32)}
+    path = ckpt.save_checkpoint(str(tmp_path), 3, payload)
+    restored = ckpt.restore_checkpoint(str(tmp_path), 3, payload)
+    assert Plan.from_state(restored["plan"]) == plan
+    assert path.endswith("checkpoint-3")
+
+
+def test_plan_dict_round_trip_and_unknown_fields():
+    plan = Plan(eigh_chunks=4, solver="rsvd", factor_comm_dtype="bf16")
+    assert Plan.from_dict(plan.to_dict()) == plan
+    with pytest.raises(ValueError, match="unknown Plan fields"):
+        Plan.from_dict({"warp_speed": 9})
+
+
+# ---------------------------------------------------------------------------
+# expected_step_variants: exact counts, plan arg, autotune budget
+# ---------------------------------------------------------------------------
+
+
+def test_variants_exact_for_composed_plans():
+    """The cadence replay counts only programs the schedule can actually
+    produce — strictly fewer than the old per-lever worst-case sum for
+    composed plans."""
+    # chunks=4 at fac 10 / kfac 100: chunk offsets 1..3 never coincide
+    # with a factor step, so only chunk 0 gets a ±factors twin:
+    # plain, factors, bootstrap, c0±f, c1, c2, c3 → 7 (old bound: 11)
+    assert expected_step_variants(
+        KFAC(damping=0.01, eigh_chunks=4)
+    ) == 7
+
+
+def test_variants_plan_arg_matches_constructed_kfac():
+    mesh = data_parallel_mesh()
+    base = KFAC(damping=0.01, mesh=mesh)
+    for plan in (
+        Plan(),
+        Plan(eigh_chunks=3),
+        Plan(factor_comm_freq=2),
+        Plan(eigh_chunks=3, factor_comm_freq=2),
+    ):
+        built = KFAC(damping=0.01, mesh=mesh, **plan.kfac_kwargs())
+        assert expected_step_variants(base, plan=plan) == (
+            expected_step_variants(built)
+        ), plan
+
+
+def test_variants_autotune_budget_term():
+    k = KFAC(damping=0.01)
+    assert (
+        expected_step_variants(k, autotune_candidates=3)
+        == expected_step_variants(k) + 6
+    )
+    assert expected_step_variants(None) == 1
+    assert expected_step_variants(None, autotune_candidates=2) == 5

@@ -100,6 +100,19 @@ def test_solver_hlo_check():
     assert "OK" in res.stdout
 
 
+def test_plan_snapshot_check():
+    """The production profile's resolved plan for the three canonical
+    (model, mesh) fixtures must match the checked-in goldens — silent
+    cost-model drift fails tier-1 instead of changing every user's levers
+    (scripts/check_plan_snapshot.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_plan_snapshot.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_no_bytecode_artifacts_tracked():
     """git must never track __pycache__ directories or .pyc files — stale
     bytecode shadows source edits and bloats the repo."""
