@@ -88,16 +88,20 @@ def _emit(error: str | None = None, partial: bool = False) -> None:
             try:
                 best = _best_overhead()
                 prod = _ARMS.get("production") or {}
+                over = _ARMS.get("overlap") or {}
                 rec = {
                     "metric": METRIC,
                     "value": best,
                     "unit": "percent",
                     "vs_baseline": round(best / 25.0, 4) if best is not None else None,
                     # THE trajectory number against the <25% target: the
-                    # composed production profile's overhead when it
-                    # measured, else the best single-lever arm (so partial
-                    # runs still track something comparable)
-                    "headline_overhead_vs_sgd": prod.get("overhead_pct", best),
+                    # production profile WITH the overlap plane when it
+                    # measured (its real operating point — fused comm +
+                    # hidden refresh), else the plain production profile,
+                    # else the best single-lever arm (so partial runs still
+                    # track something comparable)
+                    "headline_overhead_vs_sgd": over.get(
+                        "overhead_pct", prod.get("overhead_pct", best)),
                     "detail": {
                         **_META,
                         "timing": "pipelined (dispatch N, block once), "
@@ -378,6 +382,25 @@ def _compiled_memory(lowered):
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _staleness_p95(kfac, kfac_freq):
+    """p95 of the host cadence's ``kfac/staleness_age_steps`` gauge over
+    three simulated refresh intervals — pure host arithmetic (the cadence
+    does no device work), driven exactly as a trainer would. Nonzero only
+    when the arm defers factor reductions: the gauge counts capture steps of
+    statistics waiting unmerged, and with no pressure signal wired the
+    bounded-staleness budget never slips beyond that schedule-inherent age."""
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+    from kfac_pytorch_tpu.scheduler import EigenRefreshCadence
+
+    cad = EigenRefreshCadence(kfac)
+    tel = get_telemetry()
+    ages = []
+    for step in range(3 * max(1, int(kfac_freq))):
+        cad.flags_for_step(step)
+        ages.append(float(tel.gauges.get("kfac/staleness_age_steps", 0.0)))
+    return round(float(np.percentile(ages, 95)), 2)
+
+
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
                  kfac_kwargs=None, sgd_time=None, rec=None):
     """Measure SGD + the three K-FAC step variants for one configuration.
@@ -631,9 +654,19 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         eigen_table_bytes=int(eigen_table_bytes),
         refresh_ms_p50=round(float(np.percentile(win_full, 50)) * 1e3, 3),
         refresh_ms_p95=round(float(np.percentile(win_full, 95)) * 1e3, 3),
+        # Overlap-plane facts: whether the fused comm stream survived lever
+        # resolution (degrades off without a multi-device mesh), and the p95
+        # of the host cadence's staleness-age gauge over a simulated
+        # schedule — the factor-statistics age the arm actually trains with
+        overlap_enabled=bool(getattr(kfac, "comm_overlap", False)),
+        staleness_budget=int(getattr(kfac, "staleness_budget", 0)),
+        staleness_p95=_staleness_p95(kfac, kfac_freq),
     )
 
-    chunks = int(kfac_kwargs.get("eigh_chunks", 1) or 1)
+    # read the RESOLVED lever off the preconditioner, not the kwargs — a
+    # profile arm's plan can engage the chunked refresh without the arm
+    # spelling eigh_chunks, and its operating point should still be timed
+    chunks = int(getattr(kfac, "eigh_chunks", 1) or 1)
     if chunks > 1:
         # Pipelined-refresh arm: one timing per chunk-step program. Offsets
         # mirror EigenRefreshCadence — chunk c runs at interval offset c, so
@@ -912,6 +945,18 @@ def main():
         # against the <25% target (ROADMAP item 3). Reuses the f32 SGD
         # baseline (same model dtype and batch).
         ("production", "-prod", batch, None, dict(profile="production"), True),
+        # -overlap: the production profile with the overlap plane pinned on —
+        # factor-bucket reductions fused into the gradient stream, the
+        # chunked refresh hidden behind backprop (eigh_chunks pinned so the
+        # bounded-staleness budget always has slack, even where the plan
+        # drops the comm levers), and staleness_budget=1 letting a pressured
+        # flush/swap slip one step. Read refresh p95 (pipe_step_time_ms)
+        # against steady p50 for the hiding headline; its overhead_pct takes
+        # over headline_overhead_vs_sgd when it measures (docs/PERF.md
+        # "Compute/communication overlap").
+        ("overlap", "-overlap", batch, None,
+         dict(profile="production", comm_overlap=True, staleness_budget=1,
+              eigh_chunks=4), True),
         # -pipe: the chunked/double-buffered refresh (KFAC(eigh_chunks=4)) at
         # reference-parity numerics — measures the per-chunk step programs on
         # top of the standard three and reports pipe_step_time_ms (p50/p95/

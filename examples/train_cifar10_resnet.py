@@ -216,6 +216,19 @@ def parse_args(argv=None):
     p.add_argument("--solver-auto-threshold", type=int, default=512,
                    help="factor sides at least this large use the truncated "
                         "solver; smaller sides stay dense (--solver rsvd)")
+    p.add_argument("--comm-overlap", action="store_true",
+                   help="fuse the factor-statistics reduction into the "
+                        "gradient stream: the bucketed factor psums issue "
+                        "before the gradient pmean so the collectives "
+                        "interleave with backprop instead of queuing after "
+                        "it (multi-device mesh only; bitwise-identical "
+                        "numerics; docs/PERF.md)")
+    p.add_argument("--staleness-budget", type=int, default=0,
+                   help="let a deferred factor flush or a completed pending "
+                        "eigen swap slip up to this many steps under "
+                        "measured comm/compute pressure (needs "
+                        "--factor-comm-freq > 1 or --eigh-chunks > 1; 0 = "
+                        "never slip; watch the kfac/staleness_* gauges)")
     p.add_argument("--profile", default=None,
                    choices=["safe", "memory", "production"],
                    help="resolve the K-FAC perf levers from a named planner "
@@ -241,8 +254,14 @@ def main(argv=None):
     args = parse_args(argv)
     rng = np.random.RandomState(args.seed)
 
-    # enable BEFORE any spans fire (launch.initialize below has comm spans)
-    tel = observability.configure(enabled=bool(args.telemetry_dir))
+    # enable BEFORE any spans fire (launch.initialize below has comm spans);
+    # with the overlap plane on, span barriers are dropped — a
+    # block_until_ready between dispatches would serialize the very
+    # collectives the overlap interleaves
+    tel = observability.configure(
+        enabled=bool(args.telemetry_dir),
+        block_spans=False if args.comm_overlap else None,
+    )
 
     launch.initialize()  # multi-host wiring; no-op single-process
     mesh = data_parallel_mesh()
@@ -322,6 +341,8 @@ def main(argv=None):
                 solver_rank=args.solver_rank,
                 solver_auto_threshold=args.solver_auto_threshold,
                 factor_sharding=args.factor_sharding,
+                comm_overlap=args.comm_overlap,
+                staleness_budget=args.staleness_budget,
                 profile=profile,
                 profile_shapes=profile_shapes,
             )

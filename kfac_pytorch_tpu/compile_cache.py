@@ -103,6 +103,8 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
             ),
             solver=plan.solver,
             solver_rank=plan.solver_rank,
+            staleness_budget=int(getattr(plan, "staleness_budget", 0)),
+            staleness_signal=None,
         )
 
     hp = sim.hparams
@@ -146,6 +148,41 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
             replay(cadence, 0, horizon, epoch=warm_epoch)
     finally:
         tel.enabled = prev_enabled
+
+    # Bounded-staleness slip variants. The replay above never slips: it
+    # drives the cadence with no staleness signal (pressure 0), which is
+    # also what a deterministic training run without a registered signal
+    # does. A run WITH a signal can additionally emit, within each refresh
+    # interval that has slack (chunked refresh shorter than
+    # kfac_update_freq):
+    #   - the withheld swap: the final-chunk step with ``swap_eigen``
+    #     forced off (chunk eigh lands, double-buffer swap deferred), and
+    #   - the bare-swap catch-up: any later chunk-free, non-refresh step
+    #     with ``swap_eigen`` added to promote the pending buffer.
+    # Flush slip reuses existing variants (a withheld due-flush is the
+    # non-due capture program; the catch-up is the due-flush program), so
+    # only the swap twins are budgeted. This is a deterministic superset
+    # of what any pressure trace can produce.
+    budget = int(getattr(sim, "staleness_budget", 0) or 0)
+    k_eff = max(1, min(int(getattr(sim, "eigh_chunks", 1) or 1),
+                       int(hp.kfac_update_freq)))
+    if budget > 0 and k_eff > 1 and k_eff < int(hp.kfac_update_freq):
+        extra = set()
+        for key in variants:
+            flags = dict(key)
+            if flags.get("swap_eigen") and "eigen_chunk" in flags:
+                twin = dict(flags)
+                twin["swap_eigen"] = False
+                extra.add(tuple(sorted(twin.items())))
+            if (
+                "eigen_chunk" not in flags
+                and not flags.get("update_eigen")
+                and not flags.get("swap_eigen")
+            ):
+                twin = dict(flags)
+                twin["swap_eigen"] = True
+                extra.add(tuple(sorted(twin.items())))
+        variants |= extra
 
     return len(variants) + 2 * int(autotune_candidates)
 

@@ -89,7 +89,7 @@ class Span:
         return self
 
     def __exit__(self, *exc):
-        if self._sync is not None:
+        if self._sync is not None and self._telemetry.block_spans:
             import jax
 
             jax.block_until_ready(self._sync)
@@ -110,6 +110,13 @@ class Telemetry:
 
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
+        # Whether Span.block() registrations actually barrier on exit.
+        # True gives device-inclusive durations; False records dispatch
+        # time only. The overlap plane (KFAC(comm_overlap=True)) needs
+        # False: a block_until_ready inside the fused comm/compute region
+        # drains the device queue mid-step and re-serializes exactly the
+        # collectives the overlap interleaved.
+        self.block_spans = True
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.hists: Dict[str, List[float]] = {}
@@ -187,7 +194,17 @@ def get_telemetry() -> Telemetry:
     return _GLOBAL
 
 
-def configure(enabled: bool = True) -> Telemetry:
-    """Enable/disable the process-wide registry and return it."""
+def configure(
+    enabled: bool = True, block_spans: Optional[bool] = None
+) -> Telemetry:
+    """Enable/disable the process-wide registry and return it.
+
+    ``block_spans=False`` turns span ``block()`` barriers into no-ops so
+    enabled telemetry cannot serialize an overlapped step (the trainers
+    set this automatically when ``KFAC(comm_overlap=True)``); ``None``
+    leaves the current setting untouched.
+    """
     _GLOBAL.enabled = enabled
+    if block_spans is not None:
+        _GLOBAL.block_spans = bool(block_spans)
     return _GLOBAL

@@ -35,6 +35,7 @@ bitwise-identical to the per-layer pmeans it replaced
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -95,6 +96,51 @@ def per_layer_pmean_reference(tree: PyTree, axis_name: str) -> PyTree:
     return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
 
 
+def ring_allreduce_mean(
+    buf: jnp.ndarray, axis_name: str, world: int, wire_dtype=None
+) -> jnp.ndarray:
+    """Chunked ppermute ring mean of one flat bucket — the overlap plane's
+    scheduler-visibility fallback.
+
+    XLA may serialize independent all-reduces onto one collective stream,
+    re-hiding nothing; a ring of ``world-1`` ppermute+add hops
+    (reduce-scatter phase) followed by ``world-1`` ppermute hops (allgather
+    phase) expresses the same mean as many small point-to-point transfers
+    the latency-hiding scheduler can weave between compute. The sum is
+    associated in ring order, so the result is within reduction-
+    reassociation tolerance of ``lax.pmean`` — NOT bitwise — which is why
+    this path is opt-in (``KFAC_OVERLAP_PPERMUTE=1``) while the default
+    fused overlap mode keeps the exact psum.
+    """
+    if world <= 1:
+        return buf
+    orig_dtype = buf.dtype
+    n = int(buf.shape[0])
+    pad = (-n) % world
+    if pad:
+        buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
+    if wire_dtype is not None:
+        buf = buf.astype(wire_dtype)
+    acc = buf.reshape(world, -1)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    # reduce-scatter: in hop s device d forwards its partial of chunk
+    # (d-s) mod world and folds the incoming partial of chunk (d-s-1) mod
+    # world; after world-1 hops device d owns the FULL sum of chunk
+    # (d+1) mod world.
+    for s in range(world - 1):
+        send = jnp.take(acc, jnp.mod(idx - s, world), axis=0)
+        recv = lax.ppermute(send, axis_name, perm)
+        acc = acc.at[jnp.mod(idx - s - 1, world)].add(recv)
+    # allgather: circulate each completed chunk the rest of the way round
+    for s in range(world - 1):
+        send = jnp.take(acc, jnp.mod(idx + 1 - s, world), axis=0)
+        recv = lax.ppermute(send, axis_name, perm)
+        acc = acc.at[jnp.mod(idx - s, world)].set(recv)
+    out = (acc.reshape(-1).astype(jnp.float32) / world).astype(orig_dtype)
+    return out[:n] if pad else out
+
+
 class FactorComm:
     """The factor-statistics exchange plane of one ``KFAC`` instance.
 
@@ -121,6 +167,7 @@ class FactorComm:
         comm_freq: int = 1,
         max_bucket_elems: int = 1 << 20,
         sharded: bool = False,
+        overlap: bool = False,
     ):
         if int(comm_freq) < 1:
             raise ValueError(f"Invalid factor_comm_freq: {comm_freq}")
@@ -130,6 +177,15 @@ class FactorComm:
         self.comm_freq = int(comm_freq)
         self.max_bucket_elems = int(max_bucket_elems)
         self.sharded = bool(sharded)
+        # Overlap plane (KFAC(comm_overlap=True)): issue the factor-bucket
+        # reductions interleaved with the gradient stream, in backward-layer
+        # (reversed-bucket) order. Fused mode keeps the exact per-bucket
+        # psum; KFAC_OVERLAP_PPERMUTE=1 selects the ring fallback
+        # (ring_allreduce_mean) when XLA serializes the fused collectives.
+        self.overlap = bool(overlap)
+        self.overlap_ppermute = self.overlap and os.environ.get(
+            "KFAC_OVERLAP_PPERMUTE", ""
+        ) not in ("", "0")
         self.last_wire_bytes: Optional[int] = None
         self.last_collectives: Optional[int] = None
         self._plans: Dict[Any, Tuple[FactorBucket, ...]] = {}
@@ -153,10 +209,22 @@ class FactorComm:
         collective wrapper even without ``grad_comm_dtype``. Owner-sharded
         mode (``factor_sharding="owner"``) is always active: statistics must
         stay local at capture so the reduce-scatter can land each layer's
-        mean only on its owner."""
+        mean only on its owner. Overlap mode is active for the same
+        structural reason: the fused issue order only exists inside the
+        explicit wrapper where the factor and gradient collectives share a
+        trace."""
         return self.multi_device and (
             self.defer or self.comm_dtype != _F32 or self.sharded
+            or self.overlap
         )
+
+    @property
+    def overlap_mode(self) -> int:
+        """The kfac/overlap_mode gauge value: 0 = off (serial), 1 = fused
+        psum stream, 2 = ppermute ring fallback."""
+        if not (self.overlap and self.multi_device):
+            return 0
+        return 2 if self.overlap_ppermute else 1
 
     # -- plan -----------------------------------------------------------
 
@@ -192,7 +260,36 @@ class FactorComm:
             plan = self._plan_for(leaves)
             wire_dtype = None if self.comm_dtype == _F32 else self.comm_dtype
             bufs = flatten_buckets(leaves, plan)
-            bufs = factor_ops.merge_running_avg_buckets(bufs, axis, wire_dtype)
+            if self.overlap:
+                # Backward-layer issue order: bucket entries follow leaf
+                # (forward traversal) order, so issuing the buckets reversed
+                # puts the LAST layers' statistics — ready first during
+                # backprop — on the wire first. Each bucket's mean is
+                # independent of issue position, so the values are bitwise
+                # those of the serial order; only the schedule changes.
+                order = list(range(len(bufs)))[::-1]
+                if self.overlap_ppermute:
+                    world = (
+                        int(self.mesh.shape[axis])
+                        if self.mesh is not None and axis in self.mesh.shape
+                        else 1
+                    )
+                    merged = [
+                        ring_allreduce_mean(bufs[i], axis, world, wire_dtype)
+                        for i in order
+                    ]
+                else:
+                    merged = factor_ops.merge_running_avg_buckets(
+                        [bufs[i] for i in order], axis, wire_dtype
+                    )
+                out: List[Optional[jnp.ndarray]] = [None] * len(bufs)
+                for j, i in enumerate(order):
+                    out[i] = merged[j]
+                bufs = out
+            else:
+                bufs = factor_ops.merge_running_avg_buckets(
+                    bufs, axis, wire_dtype
+                )
             leaves = unflatten_buckets(bufs, plan, leaves)
         return jax.tree_util.tree_unflatten(treedef, leaves)
 

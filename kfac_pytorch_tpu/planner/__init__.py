@@ -1,4 +1,4 @@
-"""planner/ — cost-model-driven composition of the six K-FAC perf levers.
+"""planner/ — cost-model-driven composition of the K-FAC perf levers.
 
 One production entry point over the levers PRs 2–6 landed individually:
 

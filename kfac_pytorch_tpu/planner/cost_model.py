@@ -275,6 +275,16 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     if env.world >= OWNER_MIN_WORLD:
         plan = dataclasses.replace(plan, factor_sharding="owner")
 
+    # overlap: fuse the factor exchange into the gradient stream whenever
+    # there IS one — the reorder is bitwise-inert, so the only cost is the
+    # explicit-wrapper requirement fit_plan already polices. A one-step
+    # staleness budget engages alongside it when the schedule has slack to
+    # slip into (deferred flushes or a chunked refresh).
+    if env.world > 1:
+        plan = dataclasses.replace(plan, comm_overlap=True)
+        if plan.factor_comm_freq > 1 or plan.eigh_chunks > 1:
+            plan = dataclasses.replace(plan, staleness_budget=1)
+
     # kernel: pin the fused patch-covariance kernel where it is a fast
     # path ("auto" already resolves to it on TPU; pinning records the
     # decision in the plan so the snapshot shows it)

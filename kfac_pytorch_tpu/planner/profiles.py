@@ -10,7 +10,7 @@ and each shipped its own refusal paths for the compositions it cannot run
 comm plane is inert without a multi-device mesh, ...). This module turns
 those scattered refusals into ONE declarative matrix:
 
-* :class:`Plan` — an immutable record of the six lever settings, the unit
+* :class:`Plan` — an immutable record of the lever settings, the unit
   the cost model resolves, the autotuner times, and ``KFAC(profile=...)``
   consumes.
 * :class:`PlanEnv` — the non-lever context a plan must be valid against
@@ -32,7 +32,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-# The six lever fields and their bitwise-inert defaults — must mirror the
+# The lever fields and their bitwise-inert defaults — must mirror the
 # KFAC constructor defaults exactly (preconditioner.py); test_planner.py
 # pins the correspondence.
 LEVER_FIELDS = (
@@ -44,12 +44,14 @@ LEVER_FIELDS = (
     "solver_rank",
     "solver_auto_threshold",
     "factor_sharding",
+    "comm_overlap",
+    "staleness_budget",
 )
 
 
 @dataclasses.dataclass(frozen=True)
 class Plan:
-    """One concrete composition of the six K-FAC perf levers.
+    """One concrete composition of the K-FAC perf levers.
 
     All defaults are the bitwise-inert values: a default ``Plan()`` run
     through ``KFAC(profile=Plan())`` configures exactly what ``KFAC()``
@@ -65,6 +67,8 @@ class Plan:
     solver_rank: int = 128
     solver_auto_threshold: int = 512
     factor_sharding: str = "replicated"
+    comm_overlap: bool = False
+    staleness_budget: int = 0
 
     def kfac_kwargs(self) -> Dict[str, object]:
         """The KFAC constructor kwargs this plan pins."""
@@ -81,7 +85,8 @@ class Plan:
         default = Plan()
         out = []
         for f in ("eigh_chunks", "factor_kernel", "factor_comm_dtype",
-                  "factor_comm_freq", "solver", "factor_sharding"):
+                  "factor_comm_freq", "solver", "factor_sharding",
+                  "comm_overlap", "staleness_budget"):
             if getattr(self, f) != getattr(default, f):
                 out.append(f)
         return tuple(out)
@@ -96,9 +101,11 @@ class Plan:
             raise ValueError(f"unknown Plan fields: {sorted(unknown)}")
         kwargs = dict(d)
         for f in ("eigh_chunks", "factor_comm_freq", "solver_rank",
-                  "solver_auto_threshold"):
+                  "solver_auto_threshold", "staleness_budget"):
             if f in kwargs:
                 kwargs[f] = int(kwargs[f])
+        if "comm_overlap" in kwargs:
+            kwargs["comm_overlap"] = bool(kwargs["comm_overlap"])
         return cls(**kwargs)
 
     # -- checkpoint form --------------------------------------------------
@@ -123,6 +130,8 @@ class Plan:
             "solver_rank": self.solver_rank,
             "solver_auto_threshold": self.solver_auto_threshold,
             "factor_sharding": self._SHARDINGS.index(self.factor_sharding),
+            "comm_overlap": int(self.comm_overlap),
+            "staleness_budget": self.staleness_budget,
         }
         return {k: np.asarray(v, np.int32) for k, v in enc.items()}
 
@@ -138,6 +147,9 @@ class Plan:
             solver_rank=g["solver_rank"],
             solver_auto_threshold=g["solver_auto_threshold"],
             factor_sharding=cls._SHARDINGS[g["factor_sharding"]],
+            # absent in pre-overlap checkpoints: default to inert
+            comm_overlap=bool(g.get("comm_overlap", 0)),
+            staleness_budget=g.get("staleness_budget", 0),
         )
 
     def describe(self) -> str:
@@ -161,6 +173,10 @@ class Plan:
             )
         if "factor_sharding" in on:
             bits.append("factor_sharding=owner")
+        if "comm_overlap" in on:
+            bits.append("comm_overlap=on")
+        if "staleness_budget" in on:
+            bits.append(f"staleness_budget={self.staleness_budget}")
         return "plan: " + " ".join(bits)
 
 
@@ -319,6 +335,17 @@ RULES: Tuple[Rule, ...] = (
                 "pure-data-parallel collective wrapper (training/step.py "
                 "require_pure_dp_mesh); a multi-axis mesh cannot use them",
     ),
+    Rule(
+        name="overlap_vs_multi_axis_mesh",
+        applies=lambda p: p.comm_overlap,
+        conflicts=lambda p, e: e.multi_device and not e.pure_dp,
+        drop=("comm_overlap",),
+        enforced_by="train_step",
+        message="comm_overlap=True fuses factor reductions into the "
+                "gradient pmean inside the explicit pure-data-parallel "
+                "wrapper (training/step.py require_pure_dp_mesh); a "
+                "multi-axis mesh cannot use it",
+    ),
     # Degrade rules: not refusals — the constructor warns and runs with the
     # lever inert — but a RESOLVED plan should not carry dead levers, so
     # fit_plan clears them too (and reports them as dropped).
@@ -339,6 +366,32 @@ RULES: Tuple[Rule, ...] = (
         enforced_by="degrade",
         message="factor_comm_dtype/factor_comm_freq shape a cross-replica "
                 "exchange that does not exist without a multi-device mesh",
+    ),
+    Rule(
+        name="overlap_vs_single_device",
+        applies=lambda p: p.comm_overlap,
+        conflicts=lambda p, e: not e.multi_device,
+        drop=("comm_overlap",),
+        enforced_by="degrade",
+        message="comm_overlap=True has no effect without a multi-device "
+                "mesh — there is no factor exchange to overlap",
+    ),
+    # Last on purpose: its conflict is plan-internal, so it must see the
+    # plan AFTER every rule above has cleared levers — a fitted plan that
+    # lost its deferral/chunking slack must lose the budget too, or the
+    # constructor would refuse the fit_plan output.
+    Rule(
+        name="staleness_requires_slack",
+        applies=lambda p: p.staleness_budget > 0,
+        conflicts=lambda p, e: not (
+            p.factor_comm_freq > 1 or p.eigh_chunks > 1
+        ),
+        drop=("staleness_budget",),
+        enforced_by="constructor",
+        message="staleness_budget > 0 bounds how far a deferred factor "
+                "flush or a pending eigen swap may slip, and this "
+                "configuration has neither: enable factor_comm_freq > 1 "
+                "(deferred flushes) or eigh_chunks > 1 (pending swaps)",
     ),
 )
 

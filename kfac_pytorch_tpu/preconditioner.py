@@ -149,6 +149,8 @@ class KFAC:
         solver_rank: int = 128,
         solver_auto_threshold: int = 512,
         factor_sharding: str = "replicated",
+        comm_overlap: bool = False,
+        staleness_budget: int = 0,
         profile: Optional[Any] = None,
         profile_shapes: Optional[Any] = None,
     ):
@@ -332,6 +334,8 @@ class KFAC:
                 "solver_rank": solver_rank,
                 "solver_auto_threshold": solver_auto_threshold,
                 "factor_sharding": factor_sharding,
+                "comm_overlap": comm_overlap,
+                "staleness_budget": staleness_budget,
             }
             for field, value in plan.kfac_kwargs().items():
                 if levers[field] == getattr(plan_defaults, field):
@@ -344,6 +348,8 @@ class KFAC:
             solver_rank = levers["solver_rank"]
             solver_auto_threshold = levers["solver_auto_threshold"]
             factor_sharding = levers["factor_sharding"]
+            comm_overlap = levers["comm_overlap"]
+            staleness_budget = levers["staleness_budget"]
             self.plan = plan
             self.plan_dropped = tuple(dropped)
             self.plan_report = report
@@ -506,12 +512,32 @@ class KFAC:
             isinstance(factor_comm_freq, int) and 0 < factor_comm_freq,
             factor_comm_freq,
         )
+        # Overlap plane (the scheduling lever): comm_overlap=True issues the
+        # factor-statistics bucket reductions interleaved with the gradient
+        # pmean in the explicit shard_map wrapper (training/step.py), in
+        # backward-layer order, so early-layer statistics cross the wire
+        # while late-layer work is still in flight. psum results are
+        # independent of issue position and bucket order, so the fused
+        # stream is bitwise-identical to the serial one — it only changes
+        # what the XLA scheduler may run concurrently.
+        _validate("comm_overlap", isinstance(comm_overlap, bool), comm_overlap)
+        if comm_overlap and (mesh is None or mesh.devices.size <= 1):
+            # Degrade, not refuse (planner rule overlap_vs_single_device):
+            # trainers pass the same flags to 1-device dev runs, and there
+            # is no cross-replica stream to fuse into.
+            print(
+                "WARNING: comm_overlap=True has no effect without a "
+                "multi-device mesh — there is no factor exchange to overlap"
+            )
+            comm_overlap = False
+        self.comm_overlap = bool(comm_overlap)
         self.factor_comm = FactorComm(
             mesh=mesh,
             axis_name=axis_name,
             comm_dtype=factor_comm_dtype,
             comm_freq=factor_comm_freq,
             sharded=self.owner_sharded,
+            overlap=self.comm_overlap,
         )
         if (
             factor_comm_freq > 1 or self.factor_comm.comm_dtype != jnp.dtype("float32")
@@ -525,6 +551,35 @@ class KFAC:
                 "cross-replica factor exchange and have no effect without a "
                 "multi-device mesh= — factor statistics stay local and exact"
             )
+        # Bounded-staleness budget: staleness_budget=S lets the cadence
+        # (scheduler.EigenRefreshCadence) slip a deferred factor flush or a
+        # pending eigen swap by up to S steps when the measured
+        # comm/compute pressure says the wire is saturated. S=0 (default)
+        # never slips — bitwise-inert. S>0 needs something that CAN slip:
+        # a deferred flush (factor_comm_freq>1) or a pipelined swap
+        # (eigh_chunks>1); refusing the slack-free combination keeps the
+        # lever from silently meaning nothing (planner rule
+        # staleness_requires_slack).
+        _validate(
+            "staleness_budget",
+            isinstance(staleness_budget, int) and staleness_budget >= 0,
+            staleness_budget,
+        )
+        if staleness_budget > 0 and not (factor_comm_freq > 1 or eigh_chunks > 1):
+            raise ValueError(
+                "staleness_budget > 0 bounds how far a deferred factor "
+                "flush or a pending eigen swap may slip, and this "
+                "configuration has neither: enable factor_comm_freq > 1 "
+                "(deferred reduction) or eigh_chunks > 1 (pipelined "
+                "refresh), or leave staleness_budget=0"
+            )
+        self.staleness_budget = int(staleness_budget)
+        # Host-side comm/compute pressure source for the slip decision:
+        # a zero-arg callable returning the measured comm/compute ratio
+        # (bench/trainers wire one up from their timers). None → ratio 0 →
+        # the cadence never slips, keeping replays (expected_step_variants)
+        # and tests deterministic by default.
+        self.staleness_signal = None
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -793,6 +848,10 @@ class KFAC:
             # accumulators; the re-scatter treats them as synced (age 0) —
             # restore-time migration should come from a flushed checkpoint
             new_state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+        if self.staleness_budget > 0:
+            new_state["eigen_swap_slip"] = state.get(
+                "eigen_swap_slip", jnp.zeros((), jnp.int32)
+            )
         return jax.device_put(new_state, self.state_shardings(new_state))
 
     def _eigen_entries_from_split(
@@ -965,6 +1024,13 @@ class KFAC:
             # merge (0 == globally synced); fixed from init so the state
             # pytree structure never changes mid-run.
             state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+        if self.staleness_budget > 0:
+            # Bounded-staleness bookkeeping: 1 while a fully-landed pending
+            # eigenbasis is waiting for its (slipped) swap, else 0. The slip
+            # DEPTH is host-side cadence state (kfac/eigen_swap_slip gauge);
+            # this in-state flag is what checkpoints/tests read. Fixed from
+            # init like the other optional keys.
+            state["eigen_swap_slip"] = jnp.zeros((), jnp.int32)
         if self.track_diagnostics:
             # fixed from init so the state pytree structure never changes
             # (a mid-run structure flip would retrace the jitted step and
@@ -1035,6 +1101,8 @@ class KFAC:
                 for name in facs
             }
             state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+        if self.staleness_budget > 0:
+            state["eigen_swap_slip"] = jnp.zeros((), jnp.int32)
         return jax.device_put(state, self.state_shardings(state))
 
     # ------------------------------------------------------------------
@@ -1111,10 +1179,29 @@ class KFAC:
             if not (0 < k and 0 <= c < k):
                 raise ValueError(f"Invalid eigen_chunk: {eigen_chunk}")
         elif swap_eigen:
-            raise ValueError(
-                "swap_eigen=True without eigen_chunk=: the swap rides the "
-                "final chunk's step so the program count stays bounded"
-            )
+            # The bare-swap catch-up variant: a slipped swap (bounded
+            # staleness) lands on a later step that runs no chunk — only
+            # legal when a budget licenses the slip; without one the swap
+            # must ride the final chunk's step so the program count stays
+            # bounded.
+            if self.staleness_budget <= 0:
+                raise ValueError(
+                    "swap_eigen=True without eigen_chunk=: the swap rides "
+                    "the final chunk's step so the program count stays "
+                    "bounded (only a staleness_budget > 0 configuration "
+                    "may land a slipped swap on a chunk-free step)"
+                )
+            if self.eigh_chunks <= 1:
+                raise ValueError(
+                    "swap_eigen=True requires KFAC(eigh_chunks > 1) — the "
+                    "state carries no eigen_pending double buffer to promote"
+                )
+            if update_eigen:
+                raise ValueError(
+                    "swap_eigen= and update_eigen=True are mutually "
+                    "exclusive: the monolithic refresh installs its own "
+                    "basis"
+                )
         if flush_factors and not self.factor_comm.defer:
             raise ValueError(
                 "flush_factors=True without deferred factor communication "
@@ -1206,6 +1293,24 @@ class KFAC:
         # Per-layer eigenvalue spectra captured (pre-split) on eigen-update
         # steps for the health diagnostics; None on every other path.
         fresh_spectra = None
+
+        # Overlap plane, mechanism (b): on a chunk-only step the chunk
+        # feeds ONLY the pending double buffer — nothing the preconditioned
+        # gradients read — so emit the precondition FIRST. The traced values
+        # are identical either way (pure dataflow); what changes is program
+        # order, which keeps the gradient outputs off the chunk-eigh's
+        # critical path so async dispatch can overlap chunk k with step
+        # k+1's backprop. Gated on comm_overlap so the default emission
+        # order (and HLO) is untouched.
+        precond_early = (
+            self.comm_overlap and eigen_chunk is not None and not swap_eigen
+        )
+        if precond_early:
+            with tel.span("trace/kfac/precondition"):
+                new_grads, gmats, updates, nu = self._precondition_replicated(
+                    grads, names, facs, eigen, stacked, lr, damping
+                )
+
         if update_eigen and self.precond_method == "inverse":
             # Curvature refresh, inverse method: π-damped Cholesky inverses.
             # Computed replicated — a batched Cholesky solve is ~30x cheaper
@@ -1354,49 +1459,35 @@ class KFAC:
                         for n in names
                     }
                 eigen, stacked = precond_ops.split_eigen_state(full)
+        elif swap_eigen:
+            # Bare-swap catch-up (bounded staleness): a swap that slipped
+            # past its final-chunk step lands here — every chunk is in the
+            # pending buffer already, so just promote it, exactly as the
+            # riding-swap branch above does, without running any chunk.
+            full = {n: dict(e) for n, e in pending.items()}
+            for n in names:
+                if "A_diag" in facs[n]:
+                    d = facs[n]["A_diag"]
+                    full[n]["dA"] = d * (d > self.eps)
+            if self.solver == "rsvd":
+                spectrum_mass = self._spectrum_mass(facs, full, names)
+            if self.track_diagnostics:
+                fresh_spectra = {
+                    n: (
+                        _side_spectrum(full[n], "A"),
+                        _side_spectrum(full[n], "G"),
+                    )
+                    for n in names
+                }
+            eigen, stacked = precond_ops.split_eigen_state(full)
 
         # Precondition every layer's gradient, every step
         # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
-        with tel.span("trace/kfac/precondition"):
-            lgrads = capture.layer_grads(grads, names)
-            gmats = {
-                name: mat.astype(jnp.float32)
-                for name, mat in capture.grad_mats(lgrads).items()
-            }
-            precision_args = (
-                (self.precond_precision,) if self.precond_precision is not None else ()
-            )
-            inverse = self.precond_method == "inverse"
-            if self.distribute_precondition and self._world() > 1:
-                owners = precondition_assignment(
-                    {name: tuple(g.shape) for name, g in gmats.items()},
-                    self._world(),
-                    diag_a={n for n, f in facs.items() if "A_diag" in f},
+        if not precond_early:
+            with tel.span("trace/kfac/precondition"):
+                new_grads, gmats, updates, nu = self._precondition_replicated(
+                    grads, names, facs, eigen, stacked, lr, damping
                 )
-                dist_fn = (
-                    precond_ops.precondition_all_inv_distributed
-                    if inverse
-                    else precond_ops.precondition_all_distributed
-                )
-                updates = dist_fn(
-                    gmats, eigen, damping, *precision_args, stacked=stacked,
-                    mesh=self.mesh, owners=owners,
-                    comm_dtype=self.precond_comm_dtype,
-                )
-            elif inverse:
-                updates = precond_ops.precondition_all_inv(
-                    gmats, eigen, *precision_args, stacked=stacked
-                )
-            else:
-                updates = precond_ops.precondition_all(
-                    gmats, eigen, damping, *precision_args, stacked=stacked
-                )
-
-            # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
-            nu = precond_ops.kl_clip_coefficient(
-                updates, gmats, lr, self.hparams.kl_clip
-            )
-            new_grads = capture.write_back(grads, updates, nu)
 
         new_state = {
             "step": state["step"] + 1,
@@ -1414,12 +1505,75 @@ class KFAC:
                 if flush_factors
                 else state["factor_sync_age"] + int(update_factors)
             )
+        if "eigen_swap_slip" in state:
+            # 1 while a fully-landed pending basis waits for a slipped swap
+            # (set on the final-chunk step that withheld swap_eigen), 0 once
+            # any swap/refresh installs a basis. Pure function of the static
+            # flags, so it adds no step variants of its own.
+            last_chunk_no_swap = (
+                eigen_chunk is not None
+                and eigen_chunk[0] == eigen_chunk[1] - 1
+                and not swap_eigen
+            )
+            new_state["eigen_swap_slip"] = (
+                jnp.zeros((), jnp.int32)
+                if (swap_eigen or update_eigen)
+                else state["eigen_swap_slip"] + int(last_chunk_no_swap)
+            )
         if self.track_diagnostics:
             new_state["diagnostics"] = self._diagnostics(
                 state["diagnostics"], fresh_spectra, gmats, updates, nu,
                 damping, update_eigen or swap_eigen,
             )
         return new_grads, new_state
+
+    def _precondition_replicated(
+        self, grads, names, facs, eigen, stacked, lr, damping
+    ):
+        """The every-step precondition + KL clip of the replicated flow,
+        factored out so the overlap plane can emit it either before the
+        chunk-eigh (comm_overlap chunk-only steps) or after the refresh
+        branches (everywhere else) without duplicating the dispatch."""
+        lgrads = capture.layer_grads(grads, names)
+        gmats = {
+            name: mat.astype(jnp.float32)
+            for name, mat in capture.grad_mats(lgrads).items()
+        }
+        precision_args = (
+            (self.precond_precision,) if self.precond_precision is not None else ()
+        )
+        inverse = self.precond_method == "inverse"
+        if self.distribute_precondition and self._world() > 1:
+            owners = precondition_assignment(
+                {name: tuple(g.shape) for name, g in gmats.items()},
+                self._world(),
+                diag_a={n for n, f in facs.items() if "A_diag" in f},
+            )
+            dist_fn = (
+                precond_ops.precondition_all_inv_distributed
+                if inverse
+                else precond_ops.precondition_all_distributed
+            )
+            updates = dist_fn(
+                gmats, eigen, damping, *precision_args, stacked=stacked,
+                mesh=self.mesh, owners=owners,
+                comm_dtype=self.precond_comm_dtype,
+            )
+        elif inverse:
+            updates = precond_ops.precondition_all_inv(
+                gmats, eigen, *precision_args, stacked=stacked
+            )
+        else:
+            updates = precond_ops.precondition_all(
+                gmats, eigen, damping, *precision_args, stacked=stacked
+            )
+
+        # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
+        nu = precond_ops.kl_clip_coefficient(
+            updates, gmats, lr, self.hparams.kl_clip
+        )
+        new_grads = capture.write_back(grads, updates, nu)
+        return new_grads, gmats, updates, nu
 
     def _update_owner(
         self,
@@ -1530,6 +1684,18 @@ class KFAC:
         eigen_shard = state["eigen_shard"]
         pending = state.get("eigen_pending_shard")
         spectrum_mass = state.get("spectrum_mass")
+        # Overlap plane, mechanism (b) — owner form: chunk-only steps leave
+        # eigen_shard untouched, so the precondition (and its allgather) can
+        # be emitted ahead of the chunk work. See the replicated flow's
+        # precond_early comment.
+        precond_early = (
+            self.comm_overlap and eigen_chunk is not None and not swap_eigen
+        )
+        if precond_early:
+            with tel.span("trace/kfac/precondition"):
+                new_grads = self._precondition_owner(
+                    grads, gmats, eigen_shard, lr, damping, plan
+                )
         if update_eigen:
             with tel.span("trace/kfac/eigh"):
                 eigen_shard = owner_eigen_update(
@@ -1580,27 +1746,25 @@ class KFAC:
                         self.axis_name,
                         rank_fn=self._rank_fn(),
                     )
+        elif swap_eigen:
+            # Bare-swap catch-up (bounded staleness), owner form: promote
+            # the fully-landed pending shard without running any chunk.
+            eigen_shard = pending
+            if self.solver == "rsvd":
+                spectrum_mass = owner_spectrum_mass(
+                    shard,
+                    eigen_shard,
+                    plan,
+                    self.mesh,
+                    self.axis_name,
+                    rank_fn=self._rank_fn(),
+                )
 
-        with tel.span("trace/kfac/precondition"):
-            precision_args = (
-                (self.precond_precision,)
-                if self.precond_precision is not None
-                else ()
-            )
-            updates = precond_ops.precondition_all_owner(
-                gmats,
-                eigen_shard,
-                damping,
-                *precision_args,
-                mesh=self.mesh,
-                plan=plan,
-                rank_fn=self._rank_fn(),
-                eigen_dtype=self.eigen_dtype,
-            )
-            nu = precond_ops.kl_clip_coefficient(
-                updates, gmats, lr, self.hparams.kl_clip
-            )
-            new_grads = capture.write_back(grads, updates, nu)
+        if not precond_early:
+            with tel.span("trace/kfac/precondition"):
+                new_grads = self._precondition_owner(
+                    grads, gmats, eigen_shard, lr, damping, plan
+                )
 
         new_state = {
             "step": state["step"] + 1,
@@ -1621,7 +1785,42 @@ class KFAC:
                 if flush_factors
                 else state["factor_sync_age"] + int(update_factors)
             )
+        if "eigen_swap_slip" in state:
+            last_chunk_no_swap = (
+                eigen_chunk is not None
+                and eigen_chunk[0] == eigen_chunk[1] - 1
+                and not swap_eigen
+            )
+            new_state["eigen_swap_slip"] = (
+                jnp.zeros((), jnp.int32)
+                if (swap_eigen or update_eigen)
+                else state["eigen_swap_slip"] + int(last_chunk_no_swap)
+            )
         return new_grads, new_state
+
+    def _precondition_owner(self, grads, gmats, eigen_shard, lr, damping, plan):
+        """Owner-mode every-step precondition + KL clip, factored out so the
+        overlap plane can emit it before the chunk work on chunk-only
+        steps (see :meth:`_precondition_replicated`)."""
+        precision_args = (
+            (self.precond_precision,)
+            if self.precond_precision is not None
+            else ()
+        )
+        updates = precond_ops.precondition_all_owner(
+            gmats,
+            eigen_shard,
+            damping,
+            *precision_args,
+            mesh=self.mesh,
+            plan=plan,
+            rank_fn=self._rank_fn(),
+            eigen_dtype=self.eigen_dtype,
+        )
+        nu = precond_ops.kl_clip_coefficient(
+            updates, gmats, lr, self.hparams.kl_clip
+        )
+        return capture.write_back(grads, updates, nu)
 
     def _diagnostics(
         self,

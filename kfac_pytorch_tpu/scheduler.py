@@ -20,6 +20,15 @@ from typing import List, Optional
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.preconditioner import KFAC, KFACHParams
 
+#: Comm/compute pressure above which a staleness_budget > 0 cadence starts
+#: slipping deferred flushes / pending eigen swaps: the measured ratio of
+#: exposed communication time to compute time in the step. 1.0 = the wire
+#: costs as much as the math — past that, letting factor traffic slip a
+#: step buys real step time (arxiv 2007.00784 shows a half-step-stale
+#: preconditioner is accuracy-neutral). Plain module constant, like the
+#: planner's thresholds: changing it is supposed to be a visible diff.
+STALENESS_PRESSURE_THRESHOLD = 1.0
+
 
 class KFACParamScheduler:
     """Updates K-FAC hyperparameters according to the epoch.
@@ -126,6 +135,23 @@ class EigenRefreshCadence:
     instead of chunking: the init() eigenbasis is zeros, and pipelining the
     first refresh would precondition the first ``K-1`` steps with it (zero
     updates). After that bootstrap every refresh is chunked.
+
+    **Bounded staleness** (``KFAC(staleness_budget=S)`` with ``S > 0``):
+    when the host-side pressure signal (``kfac.staleness_signal``, a
+    zero-arg callable returning the measured comm/compute ratio) exceeds
+    :data:`STALENESS_PRESSURE_THRESHOLD`, the cadence lets two things slip
+    by up to ``S`` steps: a *pending eigen swap* — the final chunk's step
+    runs its chunk but withholds ``swap_eigen``; the swap lands later as a
+    bare catch-up step — and a *deferred factor flush* — a due
+    ``flush_factors`` capture step runs unflushed; the flush lands on a
+    later capture step. Hard floors the budget never crosses: a swap never
+    slips past the interval's remaining chunk-free steps (so it always
+    lands before the next refresh window opens — ``k_eff ==
+    kfac_update_freq`` therefore never slips), and the FORCED flushes
+    (monolithic refresh / chunk 0 of a pipelined pass) never slip — the
+    eigendecomposition never reads unmerged factors. With no signal wired
+    (``staleness_signal=None``, the default) the ratio reads 0 and the
+    schedule is exactly the ``S = 0`` one.
     """
 
     def __init__(self, kfac: Optional[KFAC], chunks: Optional[int] = None):
@@ -144,6 +170,20 @@ class EigenRefreshCadence:
         self._plan_key = None  # (k_eff, diag_warmup_done) of the open interval
         self._last_refresh_step: Optional[int] = None
         self._bootstrapped = False
+        # Bounded-staleness bookkeeping (staleness_budget > 0 only):
+        self._swap_pending = False  # complete pending basis awaiting swap
+        self._swap_slip = 0  # steps the current swap has slipped
+        self._flush_owed = False  # a due deferred flush was withheld
+        self._flush_slip = 0  # steps the owed flush has slipped
+        self._since_flush = 0  # capture steps since the last flush (gauge)
+
+    def _pressure(self) -> float:
+        """The measured comm/compute ratio from the trainer-wired signal;
+        0.0 (never slip) when none is wired."""
+        signal = getattr(self.kfac, "staleness_signal", None)
+        if signal is None:
+            return 0.0
+        return float(signal())
 
     def flags_for_step(self, step: int, epoch: Optional[int] = None) -> dict:
         """Static flags for ``step`` (+ chunk-phase/staleness gauges)."""
@@ -160,6 +200,12 @@ class EigenRefreshCadence:
         k_eff = max(1, min(self.chunks, hp.kfac_update_freq))
         boundary = step % hp.kfac_update_freq == 0
         chunk = None
+        budget = int(getattr(self.kfac, "staleness_budget", 0) or 0)
+        pressure = self._pressure() if budget > 0 else 0.0
+        slipping = budget > 0 and pressure > STALENESS_PRESSURE_THRESHOLD
+        # a swap may slip only into the interval's chunk-free tail, so it
+        # always lands before the next refresh window opens
+        swap_allowance = min(budget, hp.kfac_update_freq - k_eff)
         if k_eff == 1:
             flags["update_eigen"] = boundary
             if boundary:
@@ -167,6 +213,8 @@ class EigenRefreshCadence:
                 self._bootstrapped = True
                 self._landed = set()
                 self._plan_key = None
+                self._swap_pending = False
+                self._swap_slip = 0
         elif boundary and not self._bootstrapped:
             flags["update_eigen"] = True
             self._bootstrapped = True
@@ -179,13 +227,35 @@ class EigenRefreshCadence:
             if boundary:
                 self._landed = set()
                 self._plan_key = plan_key
+                # the allowance bound makes an unswapped carry-over
+                # impossible; clearing keeps a mid-run budget change safe
+                self._swap_pending = False
+                self._swap_slip = 0
             if offset < k_eff and self._plan_key == plan_key:
                 chunk = offset
                 self._landed.add(offset)
                 swap = self._landed == set(range(k_eff))
+                if swap and slipping and swap_allowance > 0:
+                    # Bounded-staleness slip: run the final chunk but
+                    # withhold the swap — the step preconditions with the
+                    # OLD basis and the completed pending basis waits.
+                    swap = False
+                    self._swap_pending = True
+                    self._swap_slip = 1
                 flags["eigen_chunk"] = (chunk, k_eff)
                 flags["swap_eigen"] = swap
                 if swap:
+                    self._last_refresh_step = step
+            elif self._swap_pending:
+                if slipping and self._swap_slip < swap_allowance:
+                    self._swap_slip += 1
+                else:
+                    # catch-up: the slipped swap lands as a bare promote
+                    # (no chunk this step — update() has the matching
+                    # bare-swap branch when staleness_budget > 0)
+                    flags["swap_eigen"] = True
+                    self._swap_pending = False
+                    self._swap_slip = 0
                     self._last_refresh_step = step
         comm = getattr(self.kfac, "factor_comm", None)
         if comm is not None and comm.defer:
@@ -193,13 +263,34 @@ class EigenRefreshCadence:
             # step, and ALWAYS before eigen reads the factors — both the
             # monolithic refresh and chunk 0 of a pipelined pass (later
             # chunks reuse the merged snapshot already in ``facs``).
-            flush = flags["update_eigen"] or (
-                flags["update_factors"]
-                and (step // hp.fac_update_freq) % comm.comm_freq == 0
+            forced = flags["update_eigen"] or chunk == 0
+            due = flags["update_factors"] and (
+                (step // hp.fac_update_freq) % comm.comm_freq == 0
             )
-            if chunk == 0:
-                flush = True
+            flush = forced or due
+            if budget > 0 and not forced:
+                if self._flush_owed:
+                    self._flush_slip += 1
+                    if flags["update_factors"] and not (
+                        slipping and self._flush_slip < budget
+                    ):
+                        # catch-up on the next capture step once pressure
+                        # drops or the budget runs out — an existing
+                        # (capture + flush) variant, no new program
+                        flush = True
+                elif due and slipping:
+                    # withhold a due (non-forced) flush under pressure
+                    flush = False
+                    self._flush_owed = True
+                    self._flush_slip = 1
+            if flush:
+                self._flush_owed = False
+                self._flush_slip = 0
             flags["flush_factors"] = flush
+            if flush:
+                self._since_flush = 0
+            elif flags["update_factors"]:
+                self._since_flush += 1
         age = (
             0
             if self._last_refresh_step is None
@@ -218,4 +309,14 @@ class EigenRefreshCadence:
         tel.set_gauge(
             "kfac/solver_rank", getattr(self.kfac, "solver_rank", 0)
         )
+        # Overlap-plane / bounded-staleness gauges: the wire-fusion mode the
+        # comm plane compiled (0 serial / 1 fused / 2 ppermute ring), how
+        # many capture steps of factor statistics are waiting unmerged, and
+        # how far the current eigen swap has slipped (0 = on schedule).
+        tel.set_gauge(
+            "kfac/overlap_mode",
+            getattr(comm, "overlap_mode", 0) if comm is not None else 0,
+        )
+        tel.set_gauge("kfac/staleness_age_steps", self._since_flush)
+        tel.set_gauge("kfac/eigen_swap_slip", self._swap_slip)
         return flags

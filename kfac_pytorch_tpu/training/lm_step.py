@@ -144,10 +144,17 @@ def make_lm_train_step(
             loss, grads, a_c, g_s, new_carry = _compute(
                 params, tokens, targets, carry, rng, capture_stats
             )
+            overlap = factor_comm is not None and factor_comm.overlap
+            if overlap and a_c is not None:
+                # overlap plane: factor buckets issue ahead of the gradient
+                # pmean so the collective streams interleave — the LM twin
+                # of training.step's fused emission order (values bitwise
+                # identical; only the schedule changes)
+                a_c, g_s = factor_comm.exchange_contribs(a_c, g_s, axis)
             wire = grad_comm_dtype if grad_comm_dtype is not None else jnp.float32
             grads = pmean_compressed(grads, axis, wire)
             loss = jax.lax.pmean(loss, axis)
-            if a_c is not None:
+            if a_c is not None and not overlap:
                 # bucketed/compressed/deferred factor exchange — the LM twin
                 # of training.step's routing through the comm plane
                 if factor_comm is not None:

@@ -100,11 +100,20 @@ def _compressed_grads(compute, mesh, comm_dtype, accum_steps, factor_comm=None):
         loss, acc, grads, new_bs, a_c, g_s = compute(
             params, batch_stats, images, labels
         )
+        overlap = factor_comm is not None and factor_comm.overlap
+        if overlap and a_c is not None:
+            # Overlap plane, mechanism (a): issue the factor-bucket
+            # reductions BEFORE the gradient pmean so the two collective
+            # streams interleave — factor statistics cross the wire while
+            # the (larger) gradient reduction is still draining, instead of
+            # queuing behind it. Every reduction is an independent mean, so
+            # the values are bitwise those of the serial order below.
+            a_c, g_s = factor_comm.exchange_contribs(a_c, g_s, axis)
         grads = pmean_compressed(grads, axis, comm_dtype)
         loss, acc = lax.pmean(loss, axis), lax.pmean(acc, axis)
         if new_bs:
             new_bs = lax.pmean(new_bs, axis)
-        if a_c is not None:
+        if a_c is not None and not overlap:
             if factor_comm is not None:
                 a_c, g_s = factor_comm.exchange_contribs(a_c, g_s, axis)
             else:

@@ -76,6 +76,56 @@ def test_span_block_syncs_device_values():
     assert tel.percentiles("step/plain")[0] > 0.0
 
 
+def test_span_block_gate_skips_barrier():
+    """block_spans=False must not drain the device queue mid-step.
+
+    Regression for the overlap plane: Span.__exit__'s block_until_ready
+    fired inside the fused comm/compute region, re-serializing exactly
+    the collectives KFAC(comm_overlap=True) interleaved. With the gate
+    off the span still records (dispatch-only timing) but never syncs.
+    """
+    tel = Telemetry(enabled=True)
+    tel.block_spans = False
+    calls = []
+    import jax as _jax
+
+    real = _jax.block_until_ready
+    _jax.block_until_ready = lambda obj: calls.append(obj) or real(obj)
+    try:
+        x = jnp.ones((16, 16))
+        with tel.span("step/plain") as sp:
+            sp.block(jnp.dot(x, x))
+    finally:
+        _jax.block_until_ready = real
+    assert calls == []  # gate held: no barrier issued
+    assert tel.percentiles("step/plain")[0] >= 0.0  # still recorded
+
+    # default path unchanged: the barrier fires when the gate is on
+    tel2 = Telemetry(enabled=True)
+    assert tel2.block_spans  # device-inclusive timing remains the default
+    _jax.block_until_ready = lambda obj: calls.append(obj) or real(obj)
+    try:
+        with tel2.span("step/plain") as sp:
+            sp.block(jnp.dot(x, x))
+    finally:
+        _jax.block_until_ready = real
+    assert len(calls) == 1
+
+    # configure() plumbs the gate without disturbing enablement elsewhere
+    g = get_telemetry()
+    prev_enabled, prev_block = g.enabled, g.block_spans
+    try:
+        assert configure(enabled=True, block_spans=False) is g
+        assert g.block_spans is False
+        configure(enabled=True)  # None leaves the gate untouched
+        assert g.block_spans is False
+        configure(enabled=True, block_spans=True)
+        assert g.block_spans is True
+    finally:
+        g.enabled, g.block_spans = prev_enabled, prev_block
+        g.reset()
+
+
 def test_disabled_is_null_and_allocation_free():
     tel = Telemetry(enabled=False)
     # the no-op span is a shared singleton: no per-call allocation

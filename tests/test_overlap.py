@@ -1,0 +1,371 @@
+"""Overlap plane: fused factor comm, hidden eigen chunks, bounded staleness.
+
+Pins the three mechanisms of ``KFAC(comm_overlap=...)`` on the 8-device CPU
+mesh: (a) the fused comm stream is a PURE REORDER — params from an
+overlap-on run bitwise-track the serial run at ``staleness_budget=0``,
+composed with every lever it shares a trace with (chunked refresh, deferred
+reduction, low-rank solver, owner sharding); (b) the bounded-staleness
+cadence slips a pending eigen swap / deferred flush only under measured
+pressure, never past its budget or a forced flush, and catches up with the
+bare-swap step ``update()`` licenses only when a budget exists; (c) the
+compiled-program count stays exactly what ``expected_step_variants``
+predicts — overlap adds ZERO programs, a budget adds only the slip twins.
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.compile_cache import expected_step_variants
+from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.scheduler import (
+    STALENESS_PRESSURE_THRESHOLD,
+    EigenRefreshCadence,
+)
+from kfac_pytorch_tpu.training.step import (
+    TrainState,
+    make_sgd,
+    make_train_step,
+)
+
+
+class _MLP(nn.Module):
+    """BN-free toy (same as test_factor_comm): isolates the wire/schedule
+    effects from BatchNorm's local-batch semantics."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(KFACDense(32, name="fc1")(x))
+        return KFACDense(10, name="fc2")(x)
+
+
+def _setup(model, kfac, mesh=None, batch=16, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(batch, 4, 6).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=batch))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    params = variables["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params) if kfac else None,
+    )
+    # f32 explicit-collective wrapper for BOTH runs: the gradient path is
+    # bitwise-identical, so any divergence is the overlap reorder's fault
+    step_fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True},
+        mesh=mesh, grad_comm_dtype=jnp.float32,
+    )
+    return state, step_fn, (x, y)
+
+
+def _put(state, batch, mesh):
+    shard = NamedSharding(mesh, P("data"))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    return state, tuple(jax.device_put(b, shard) for b in batch)
+
+
+def _assert_close(pa, pb, rtol, atol):
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pb)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
+def _mesh_kfac(**kw):
+    return KFAC(damping=0.01, mesh=data_parallel_mesh(), **kw)
+
+
+# ----------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {},
+        dict(eigh_chunks=2, kfac_update_freq=4),
+        dict(factor_comm_freq=2, kfac_update_freq=4),
+        dict(solver="rsvd", solver_rank=8, solver_auto_threshold=16,
+             kfac_update_freq=4),
+        dict(factor_sharding="owner", kfac_update_freq=4),
+    ],
+    ids=["plain", "chunked", "deferred", "rsvd", "owner"],
+)
+def test_overlap_is_pure_reorder(extra):
+    """overlap-on == overlap-off params at staleness_budget=0, per step,
+    over two full refresh intervals — composed with every lever the fused
+    stream shares a trace with. The reorder moves WHEN the factor psums
+    issue, never what they compute."""
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    runs = {}
+    for overlap in (False, True):
+        kfac = _mesh_kfac(fac_update_freq=1, comm_overlap=overlap, **extra)
+        assert kfac.comm_overlap is overlap
+        assert kfac.factor_comm.overlap_mode == (1 if overlap else 0)
+        cad = EigenRefreshCadence(kfac)
+        state, fn, batch = _setup(model, kfac, mesh=mesh)
+        state, b = _put(state, batch, mesh)
+        traj = []
+        for step in range(2 * kfac.hparams.kfac_update_freq):
+            flags = cad.flags_for_step(step)
+            state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01),
+                          **flags)
+            traj.append(jax.device_get(state.params))
+        runs[overlap] = traj
+    for p_on, p_off in zip(runs[True], runs[False]):
+        _assert_close(p_on, p_off, rtol=1e-6, atol=1e-7)
+
+
+def test_overlap_ppermute_ring_close(monkeypatch):
+    """KFAC_OVERLAP_PPERMUTE=1 swaps the fused psums for a ppermute ring
+    (reduce-scatter + allgather) — a different reduction ORDER, so parity
+    is close, not bitwise, and the mode gauge reads 2."""
+    monkeypatch.setenv("KFAC_OVERLAP_PPERMUTE", "1")
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    k_ring = _mesh_kfac(fac_update_freq=1, kfac_update_freq=2,
+                        comm_overlap=True)
+    assert k_ring.factor_comm.overlap_mode == 2
+    monkeypatch.delenv("KFAC_OVERLAP_PPERMUTE")
+    k_ref = _mesh_kfac(fac_update_freq=1, kfac_update_freq=2)
+
+    params = {}
+    for key, kfac in (("ring", k_ring), ("ref", k_ref)):
+        state, fn, batch = _setup(model, kfac, mesh=mesh)
+        state, b = _put(state, batch, mesh)
+        for step in range(4):
+            flags = EigenRefreshCadence(kfac).flags_for_step(step) if step == 0 \
+                else {"update_factors": True, "update_eigen": step % 2 == 0}
+            state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01),
+                          **flags)
+        params[key] = jax.device_get(state.params)
+    _assert_close(params["ring"], params["ref"], rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------- staleness
+
+
+def _pressured_kfac(pressure, **kw):
+    """Mesh KFAC with a staleness signal reading the mutable cell."""
+    kfac = _mesh_kfac(**kw)
+    kfac.staleness_signal = lambda: pressure[0]
+    return kfac
+
+
+@pytest.fixture
+def tel():
+    """The global telemetry, enabled and clean (gauges no-op when the
+    registry is disabled, the default outside trainers)."""
+    t = get_telemetry()
+    prev = t.enabled
+    t.enabled = True
+    t.reset()
+    yield t
+    t.reset()
+    t.enabled = prev
+
+
+def test_staleness_swap_slip_and_catchup(tel):
+    """Under pressure the final chunk withholds its swap (bounded by the
+    interval's chunk-free tail), the catch-up lands as a bare swap once the
+    budget runs out, and the gauges track the slip depth."""
+    pressure = [0.0]
+    kfac = _pressured_kfac(pressure, fac_update_freq=1, kfac_update_freq=6,
+                           eigh_chunks=2, staleness_budget=2)
+    cad = EigenRefreshCadence(kfac)
+    assert cad.flags_for_step(0)["update_eigen"]  # monolithic bootstrap
+    for s in range(1, 6):
+        cad.flags_for_step(s)
+
+    pressure[0] = STALENESS_PRESSURE_THRESHOLD + 1.0
+    f6 = cad.flags_for_step(6)
+    assert f6["eigen_chunk"] == (0, 2) and not f6.get("swap_eigen")
+    f7 = cad.flags_for_step(7)  # final chunk: run it, withhold the swap
+    assert f7["eigen_chunk"] == (1, 2) and f7["swap_eigen"] is False
+    assert tel.gauges["kfac/eigen_swap_slip"] == 1
+    f8 = cad.flags_for_step(8)  # still pressured: slip one more step
+    assert "swap_eigen" not in f8 and "eigen_chunk" not in f8
+    assert tel.gauges["kfac/eigen_swap_slip"] == 2
+    f9 = cad.flags_for_step(9)  # budget exhausted: bare-swap catch-up
+    assert f9["swap_eigen"] is True and "eigen_chunk" not in f9
+    assert tel.gauges["kfac/eigen_swap_slip"] == 0
+
+    # next interval, pressure drops mid-slip: catch-up lands immediately
+    for s in range(10, 12):
+        cad.flags_for_step(s)
+    f12 = cad.flags_for_step(12)
+    assert f12["eigen_chunk"] == (0, 2)
+    f13 = cad.flags_for_step(13)
+    assert f13["swap_eigen"] is False
+    pressure[0] = 0.0
+    f14 = cad.flags_for_step(14)
+    assert f14["swap_eigen"] is True and "eigen_chunk" not in f14
+
+
+def test_staleness_swap_never_outlives_interval():
+    """swap_allowance = kfac_update_freq - k_eff: with no chunk-free tail
+    the swap NEVER slips, however hard the pressure pushes."""
+    pressure = [STALENESS_PRESSURE_THRESHOLD + 9.0]
+    kfac = _pressured_kfac(pressure, fac_update_freq=1, kfac_update_freq=2,
+                           eigh_chunks=2, staleness_budget=3)
+    cad = EigenRefreshCadence(kfac)
+    cad.flags_for_step(0)  # bootstrap
+    cad.flags_for_step(1)
+    f2 = cad.flags_for_step(2)
+    f3 = cad.flags_for_step(3)
+    assert f2["eigen_chunk"] == (0, 2)
+    assert f3["eigen_chunk"] == (1, 2) and f3["swap_eigen"] is True
+
+
+def test_staleness_flush_slip_and_forced_floor(tel):
+    """A due deferred flush slips under pressure (staleness-age gauge
+    counts the unmerged capture steps), catches up when pressure drops,
+    and the FORCED flush before eigen work never slips."""
+    pressure = [0.0]
+    kfac = _pressured_kfac(pressure, fac_update_freq=1, kfac_update_freq=8,
+                           eigh_chunks=2, factor_comm_freq=2,
+                           staleness_budget=3)
+    cad = EigenRefreshCadence(kfac)
+    assert cad.flags_for_step(0)["flush_factors"]  # bootstrap: forced
+    assert not cad.flags_for_step(1)["flush_factors"]
+    pressure[0] = STALENESS_PRESSURE_THRESHOLD + 1.0
+    f2 = cad.flags_for_step(2)  # due flush withheld under pressure
+    assert f2["update_factors"] and not f2["flush_factors"]
+    assert not cad.flags_for_step(3)["flush_factors"]
+    assert tel.gauges["kfac/staleness_age_steps"] >= 2
+    pressure[0] = 0.0
+    f4 = cad.flags_for_step(4)  # pressure gone: owed flush lands
+    assert f4["flush_factors"]
+    assert tel.gauges["kfac/staleness_age_steps"] == 0
+
+    pressure[0] = STALENESS_PRESSURE_THRESHOLD + 1.0
+    for s in range(5, 8):
+        cad.flags_for_step(s)
+    f8 = cad.flags_for_step(8)  # chunk 0 of the refresh: flush is FORCED
+    assert f8["eigen_chunk"] == (0, 2) and f8["flush_factors"]
+
+
+def test_staleness_inert_without_signal():
+    """No wired signal (the default) reads pressure 0.0 — a budget > 0
+    schedule is flag-for-flag the budget-0 schedule (deterministic CI)."""
+    kw = dict(fac_update_freq=1, kfac_update_freq=6, eigh_chunks=2,
+              factor_comm_freq=2)
+    cad_b = EigenRefreshCadence(_mesh_kfac(staleness_budget=3, **kw))
+    cad_0 = EigenRefreshCadence(_mesh_kfac(**kw))
+    for s in range(13):
+        assert cad_b.flags_for_step(s) == cad_0.flags_for_step(s)
+
+
+def test_slipped_swap_promotes_pending_basis_exactly():
+    """E2E: the withheld-swap step preconditions with the OLD basis, and
+    the bare-swap catch-up promotes EXACTLY the pending basis the chunks
+    accumulated (atomic swap, no recompute)."""
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    pressure = [0.0]
+    kfac = _pressured_kfac(pressure, fac_update_freq=1, kfac_update_freq=4,
+                           eigh_chunks=2, staleness_budget=1,
+                           comm_overlap=True)
+    cad = EigenRefreshCadence(kfac)
+    state, fn, batch = _setup(model, kfac, mesh=mesh)
+    state, b = _put(state, batch, mesh)
+    for step in range(5):
+        state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01),
+                      **cad.flags_for_step(step))
+    pressure[0] = STALENESS_PRESSURE_THRESHOLD + 1.0
+    f5 = cad.flags_for_step(5)  # final chunk, swap withheld
+    assert f5["swap_eigen"] is False
+    state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01), **f5)
+    pending = jax.device_get(state.kfac_state["eigen_pending"])
+    assert int(jax.device_get(state.kfac_state["eigen_swap_slip"])) == 1
+    f6 = cad.flags_for_step(6)  # allowance min(1, 4-2)=1 exhausted
+    assert f6["swap_eigen"] is True and "eigen_chunk" not in f6
+    state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01), **f6)
+    active = jax.device_get(state.kfac_state["eigen"])
+    for a, p in zip(jax.tree_util.tree_leaves(active),
+                    jax.tree_util.tree_leaves(pending)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(p))
+    assert int(jax.device_get(state.kfac_state["eigen_swap_slip"])) == 0
+
+
+# -------------------------------------------------------------- variants
+
+
+def test_expected_step_variants_overlap_and_staleness():
+    """Overlap adds ZERO compiled programs; a staleness budget adds only
+    the slip twins (withheld-swap chunk steps + bare-swap catch-ups), and
+    only where the schedule has a chunk-free tail to slip into."""
+    # overlap alone: identical counts to the S=0 baselines
+    assert expected_step_variants(_mesh_kfac(comm_overlap=True)) == 3
+    assert expected_step_variants(
+        _mesh_kfac(comm_overlap=True, factor_comm_freq=2)) == 4
+    # budget on a chunked cadence: +2 withheld-swap twins of the final
+    # chunk (±factors) and +2 bare-swap twins of the chunk-free steps
+    assert expected_step_variants(
+        _mesh_kfac(eigh_chunks=3, kfac_update_freq=6)) == 8
+    assert expected_step_variants(
+        _mesh_kfac(eigh_chunks=3, kfac_update_freq=6,
+                   staleness_budget=2)) == 12
+    assert expected_step_variants(
+        _mesh_kfac(comm_overlap=True, eigh_chunks=3, kfac_update_freq=6,
+                   staleness_budget=2)) == 12
+    # composed with deferred flush: the flush twins multiply through
+    assert expected_step_variants(
+        _mesh_kfac(eigh_chunks=3, kfac_update_freq=6,
+                   factor_comm_freq=2)) == 10
+    assert expected_step_variants(
+        _mesh_kfac(eigh_chunks=3, kfac_update_freq=6, factor_comm_freq=2,
+                   staleness_budget=2)) == 16
+    # flush-slip alone reuses the existing ±flush variants: ZERO new
+    # programs when there is no chunked swap to withhold
+    assert expected_step_variants(
+        _mesh_kfac(factor_comm_freq=2, staleness_budget=2)) == 4
+
+
+# -------------------------------------------------------------- refusals
+
+
+def test_refusals():
+    mesh = data_parallel_mesh()
+    # a budget needs slack to spend: deferred reduction or chunked refresh
+    with pytest.raises(ValueError, match="staleness_budget"):
+        KFAC(damping=0.01, mesh=mesh, staleness_budget=1)
+    with pytest.raises(ValueError, match="staleness_budget"):
+        KFAC(damping=0.01, mesh=mesh, staleness_budget=-1, eigh_chunks=2,
+             kfac_update_freq=4)
+    # overlap without a multi-device mesh degrades (warns), never raises
+    k = KFAC(damping=0.01, comm_overlap=True)
+    assert k.comm_overlap is False and k.factor_comm.overlap_mode == 0
+
+
+def test_bare_swap_requires_budget():
+    """update(swap_eigen=True) without a chunk is the slipped-swap catch-up
+    program — only a staleness_budget > 0 config may compile it."""
+    model = _MLP()
+    x = jnp.zeros((8, 4, 6), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    k0 = _mesh_kfac(eigh_chunks=2, kfac_update_freq=4)
+    st = k0.init(params)
+    with pytest.raises(ValueError, match="staleness_budget"):
+        k0.update(grads, st, lr=jnp.float32(0.1), update_factors=False,
+                  update_eigen=False, swap_eigen=True)
+    k1 = _mesh_kfac(eigh_chunks=2, kfac_update_freq=4, staleness_budget=1)
+    st = k1.init(params)
+    _, st2 = k1.update(grads, st, lr=jnp.float32(0.1), update_factors=False,
+                       update_eigen=False, swap_eigen=True)
+    assert st2 is not None

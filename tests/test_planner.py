@@ -171,6 +171,12 @@ _LEVERS = {
     "owner": Plan(factor_sharding="owner"),
     "owner+chunks": Plan(factor_sharding="owner", eigh_chunks=2),
     "rsvd+comm": Plan(solver="rsvd", factor_comm_dtype="bf16"),
+    "overlap": Plan(comm_overlap=True),
+    "overlap+staleness": Plan(
+        comm_overlap=True, staleness_budget=1, eigh_chunks=2
+    ),
+    # budget with nothing to slip: refused by the constructor in EVERY env
+    "staleness_bare": Plan(staleness_budget=1),
 }
 
 # environment features, each mapping to (PlanEnv kwargs, KFAC kwargs)
@@ -292,14 +298,43 @@ def test_degrade_rules_match_constructor_warnings():
     carry dead configuration."""
     env = _env(world=1)
     plan = Plan(
-        factor_sharding="owner", factor_comm_dtype="bf16", factor_comm_freq=2
+        factor_sharding="owner", factor_comm_dtype="bf16", factor_comm_freq=2,
+        comm_overlap=True,
     )
     assert not violations(plan, env)  # no refusal...
     fitted, dropped = fit_plan(plan, env)
     assert fitted == Plan()  # ...but nothing survives on one device
-    assert set(dropped) == {"owner_vs_single_device", "comm_vs_single_device"}
+    assert set(dropped) == {
+        "owner_vs_single_device",
+        "comm_vs_single_device",
+        "overlap_vs_single_device",
+    }
     kfac = KFAC(damping=0.01, **plan.kfac_kwargs())  # warns, constructs
     assert kfac.factor_sharding == "replicated"
+    assert kfac.comm_overlap is False
+
+
+def test_fit_plan_drops_orphaned_staleness_budget():
+    """staleness_requires_slack runs LAST: a fit that strips the budget's
+    slack (deferral dropped by an earlier rule) must strip the budget too,
+    or fit_plan's output would be refused by the constructor it feeds."""
+    plan = Plan(factor_comm_freq=4, staleness_budget=2)
+    # single device: the degrade rule clears the deferral, orphaning S
+    fitted, dropped = fit_plan(plan, _env(world=1))
+    assert fitted == Plan()
+    assert "comm_vs_single_device" in dropped
+    assert "staleness_requires_slack" in dropped
+    # multi-axis mesh: the train_step comm rule clears it the same way
+    fitted, dropped = fit_plan(plan, _env(world=8, axes=("data", "seq")))
+    assert fitted.staleness_budget == 0
+    assert "staleness_requires_slack" in dropped
+    # ...but chunking slack keeps the budget alive through the same fit
+    fitted, dropped = fit_plan(
+        dataclasses.replace(plan, eigh_chunks=2),
+        _env(world=8, axes=("data", "seq")),
+    )
+    assert fitted.staleness_budget == 2 and fitted.eigh_chunks == 2
+    assert "staleness_requires_slack" not in dropped
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,20 @@ def test_collective_count_check():
     assert "OK" in res.stdout
 
 
+def test_overlap_hlo_check():
+    """The overlap plane's compiled capture step must issue no MORE
+    all-reduces than the serial program, and in the traced jaxpr no
+    gradient/loss psum may be data-dependent on a factor-bucket psum —
+    overlap is a pure reorder, never a semantic rewrite
+    (scripts/check_overlap_hlo.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_overlap_hlo.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_solver_hlo_check():
     """The solver='rsvd' refresh program must contain zero eigendecomposition
     custom-calls at/above the truncation threshold — a dense eigh sneaking
