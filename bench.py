@@ -732,22 +732,27 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
 
 def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
                     d_model=512, n_heads=8, n_layers=4, vocab=2048,
-                    sgd_only=False):
+                    sgd_only=False, model_kwargs=None, kfac_kwargs=None):
     """Transformer-LM arm: SGD step + (optionally) amortized K-FAC overhead.
 
     Sized so the attention cost is visible (seq 2048: naive materializes the
     [b,h,t,t] score tensor the flash kernel never does) while the decoder's
-    G factor (vocab side) stays cheap to eigendecompose at bench iters."""
+    G factor (vocab side) stays cheap to eigendecompose at bench iters.
+    ``model_kwargs`` reach ``transformer_lm.get_model`` (the -lm-embed arm
+    turns on ``kfac_embedding``); ``kfac_kwargs`` reach the ``KFAC``
+    constructor (profile, factor_kernel, ...)."""
     from kfac_pytorch_tpu import KFAC, capture
     from kfac_pytorch_tpu.models import transformer_lm
     from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
 
+    model_kwargs = dict(model_kwargs or {})
+    kfac_kwargs = dict(kfac_kwargs or {})
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, vocab, size=(batch, seq)).astype(np.int32))
     targets = jnp.asarray(rng.randint(0, vocab, size=(batch, seq)).astype(np.int32))
     model = transformer_lm.get_model(
         vocab, max_len=seq, d_model=d_model, n_heads=n_heads,
-        n_layers=n_layers, attention_fn=attn_fn,
+        n_layers=n_layers, attention_fn=attn_fn, **model_kwargs,
     )
     variables = model.init(jax.random.PRNGKey(0), tokens, train=True)
     params = variables["params"]
@@ -780,9 +785,21 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
     if sgd_only:
         return out
 
+    if "profile" in kfac_kwargs:
+        from kfac_pytorch_tpu.planner import model_facts
+
+        layers = capture.discover_layers(model, tokens, train=True)
+        kfac_kwargs.setdefault("layers", layers)
+        kfac_kwargs.setdefault(
+            "profile_shapes", model_facts(params, layers=layers))
+    else:
+        kfac_kwargs.setdefault(
+            "layers", capture.discover_layers(model, tokens, train=True))
     kfac = KFAC(damping=0.003, fac_update_freq=fac_freq,
-                kfac_update_freq=kfac_freq,
-                layers=capture.discover_layers(model, tokens, train=True))
+                kfac_update_freq=kfac_freq, **kfac_kwargs)
+    if kfac.plan is not None:
+        out["plan"] = kfac.plan.to_dict()
+        out["plan_dropped"] = list(kfac.plan_dropped)
     kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
 
     def run_kfac(uf, ue):
@@ -793,7 +810,24 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
         return _step
 
     _log(f"lm-{attn_name} kfac: compiling full step ...")
-    s_kfac = run_kfac(True, True)(fresh_state(kfac))
+    embed_kernel_gauge = None
+    if model_kwargs.get("kfac_embedding"):
+        # the embedding-capture kernel gauge lands at capture-trace time;
+        # enable the registry only around the compile so span barriers
+        # never touch the timed loops
+        from kfac_pytorch_tpu.observability import telemetry
+
+        tel = telemetry.get_telemetry()
+        was_enabled = tel.enabled
+        telemetry.configure(enabled=True, block_spans=False)
+        try:
+            s_kfac = run_kfac(True, True)(fresh_state(kfac))
+            embed_kernel_gauge = tel.gauges.get(
+                "kfac/embedding_capture_kernel")
+        finally:
+            tel.enabled = was_enabled
+    else:
+        s_kfac = run_kfac(True, True)(fresh_state(kfac))
     t_plain, sd_plain, win_plain, s_kfac = _timeit(
         run_kfac(False, False), s_kfac, iters=10,
         label=f"lm-{attn_name} kfac precond-only")
@@ -823,7 +857,24 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
         },
         "step_time_ms": _schedule_stats(
             win_plain, win_fac, [win_full], fac_freq, kfac_freq),
+        "refresh_ms_p50": round(float(np.percentile(win_full, 50)) * 1e3, 3),
+        "refresh_ms_p95": round(float(np.percentile(win_full, 95)) * 1e3, 3),
     })
+    if model_kwargs.get("kfac_embedding"):
+        # the -lm-embed arm's headline facts: which capture kernel the
+        # dispatch picked (1.0 = pallas token-gather, 0.0 = dense oracle —
+        # the gauge lands at capture-trace time), and the curvature-state
+        # footprint the diagonal-A layout keeps (a [vocab] vector where a
+        # dense embedding A factor would be [vocab, vocab])
+        out["embedding_capture_kernel"] = embed_kernel_gauge
+        world = kfac.mesh.devices.size if getattr(kfac, "mesh", None) else 1
+        sharded = ("factor_shard", "eigen_shard", "eigen_pending_shard")
+        out["factor_state_bytes_local"] = int(sum(
+            leaf.nbytes // (world if key in sharded else 1)
+            for key in ("factors", "eigen", "eigen_stacked") + sharded
+            for leaf in jax.tree_util.tree_leaves(
+                s_kfac.kfac_state.get(key, {}))
+        ))
     return out
 
 
@@ -847,14 +898,21 @@ def _transformer_bench(fac_freq, kfac_freq):
         batch, seq = b, s
         lm_kw = dict(d_model=dm, n_heads=nh, n_layers=nl, vocab=vo)
     sub_arms = [
-        ("naive-kfac", full_attention, False),
-        ("flash-kfac", best_attention_fn(), False),
+        ("naive-kfac", full_attention, False, {}),
+        ("flash-kfac", best_attention_fn(), False, {}),
+        # -lm-embed: the modern-architecture arm — K-FAC over the token
+        # embedding (diagonal-A, token-gather capture kernel) under the
+        # production profile; read embedding_capture_kernel (1.0 = pallas),
+        # factor_state_bytes_local, and refresh_ms_p50/p95 from its record
+        ("embed-kfac", best_attention_fn(), False,
+         dict(model_kwargs=dict(kfac_embedding=True),
+              kfac_kwargs=dict(profile="production"))),
     ]
-    for name, fn, sgd_only in sub_arms:
+    for name, fn, sgd_only, extra in sub_arms:
         try:
             _LM_ARMS[name] = _measure_lm_arm(
                 name.split("-")[0], fn, batch, seq, fac_freq, kfac_freq,
-                sgd_only=sgd_only, **lm_kw)
+                sgd_only=sgd_only, **lm_kw, **extra)
         except Exception as e:  # noqa: BLE001 — sub-arms are independent
             _log(f"transformer arm {name} failed: {type(e).__name__}: {e}")
             _LM_ARMS[name] = {"error": f"{type(e).__name__}: {e}"[:300]}

@@ -6,6 +6,10 @@ examples, with attention either replicated (``--seq-parallel 1``) or sharded
 over a ``seq`` mesh axis via ring attention / Ulysses all-to-all
 (``--seq-parallel N --attention ring|ulysses``, parallel/context.py). The
 device mesh is data×seq; batch shards over ``data``, sequence over ``seq``.
+Alternatively ``--tensor-parallel N`` builds the 2-D data×tensor mesh
+(parallel/mesh.py): compute replicates over ``tensor`` while every K-FAC
+collective rides the ``data`` axis, so the owner/comm/overlap levers all
+stay available.
 
 Synthetic smoke:
     python examples/train_transformer_lm.py --synthetic --epochs 1 \
@@ -79,6 +83,13 @@ def parse_args(argv=None):
     # parallelism: seq-parallel devices; remaining devices form the data axis
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="devices on the 'seq' mesh axis (1 = no sequence parallelism)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="devices on the 'tensor' axis of a 2-D data×tensor "
+                        "mesh (parallel/mesh.py data_tensor_mesh): params "
+                        "and compute replicate over it while every K-FAC "
+                        "collective — factor buckets, owner reduce-scatter, "
+                        "the preconditioned-grad allgather — rides the "
+                        "'data' axis only; incompatible with --seq-parallel")
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     # K-FAC (same surface as the CNN trainers)
     p.add_argument("--remat", action="store_true",
@@ -88,7 +99,21 @@ def parse_args(argv=None):
                         "sequences on TPU")
     p.add_argument("--kfac-embedding", action="store_true",
                    help="precondition the token embedding too (diagonal-A "
-                        "K-FAC; beyond the reference's Linear/Conv2d set)")
+                        "K-FAC; beyond the reference's Linear/Conv2d set); "
+                        "capture streams token counts in O(B*T) via the "
+                        "Pallas token-gather kernel on TPU (ops/"
+                        "factor_kernels.py) — no [B*T,V] one-hot ever exists")
+    p.add_argument("--qkv-lens", action="store_true",
+                   help="expand-lens on each block's fused QKV projection: "
+                        "three d_model-side G factors for the Q/K/V column "
+                        "slices instead of one 3*d_model-side factor — ~9x "
+                        "lighter refresh, bitwise-equal to an unfused "
+                        "three-layer projection (models/transformer_lm.py)")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="decoder head reuses the token-embedding table "
+                        "(logits = x @ W.T); with --kfac-embedding the tied "
+                        "table accumulates ONE set of K-FAC statistics over "
+                        "both use sites (reduce lens)")
     p.add_argument("--kfac-update-freq", type=int, default=10, help="0 disables K-FAC")
     p.add_argument("--eigh-chunks", type=int, default=1,
                    help="pipeline the eigen refresh over this many steps "
@@ -123,9 +148,10 @@ def parse_args(argv=None):
                         "stats reduce-scatter onto each layer's eigen-owner "
                         "and ONE allgather replicates the preconditioned "
                         "grads; O(model/devices) factor memory and wire "
-                        "(docs/PERF.md); pure-DP only (--seq-parallel 1), "
-                        "incompatible with --kfac-embedding (diagonal-A "
-                        "factors have no dense matrix to shard)")
+                        "(docs/PERF.md); needs a single data axis "
+                        "(--seq-parallel 1; --tensor-parallel composes). "
+                        "Diagonal-A embedding factors shard as [vocab] "
+                        "vector slots, so --kfac-embedding composes too")
     p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
                    help="curvature eigensolver: eigh = full (dense) "
                         "eigendecomposition, rsvd = randomized truncated "
@@ -191,14 +217,26 @@ def main(argv=None):
     launch.initialize()
     devices = np.asarray(jax.devices())
     sp = args.seq_parallel
+    tp = args.tensor_parallel
+    if sp > 1 and tp > 1:
+        raise SystemExit(
+            "--seq-parallel and --tensor-parallel are separate second mesh "
+            "axes; pick one"
+        )
     if devices.size % sp != 0:
         raise SystemExit(f"--seq-parallel {sp} must divide device count {devices.size}")
+    if devices.size % max(1, tp) != 0:
+        raise SystemExit(
+            f"--tensor-parallel {tp} must divide device count {devices.size}"
+        )
     if args.seq_len % sp != 0:
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
     # CLI lever composition routed through the planner's validity matrix —
     # the same Rule rows KFAC.__init__/init enforce produce the refusal
-    # messages here (owner×seq-parallel, owner×--kfac-embedding and
-    # factor-comm×seq-parallel were ad-hoc SystemExits before PLANNER)
+    # messages here (owner×seq-parallel and factor-comm×seq-parallel were
+    # ad-hoc SystemExits before PLANNER). A 'tensor' axis is exempt: the
+    # matrix's pure_dp predicate knows K-FAC collectives still ride one
+    # data axis through it.
     from kfac_pytorch_tpu import planner
 
     cli_plan = planner.Plan(
@@ -212,11 +250,17 @@ def main(argv=None):
         comm_overlap=args.comm_overlap,
         staleness_budget=args.staleness_budget,
     )
+    if sp > 1:
+        lever_axes = ("data", "seq")
+    elif tp > 1:
+        lever_axes = ("data", "tensor")
+    else:
+        lever_axes = ("data",)
     lever_env = planner.PlanEnv(
         world=int(devices.size),
-        # sp == 1 trains on the pure-DP one-axis mesh built below; a REAL
-        # seq axis is what the owner/comm levers cannot ride
-        mesh_axes=("data",) if sp == 1 else ("data", "seq"),
+        # a REAL seq axis is what the owner/comm levers cannot ride; the
+        # tensor axis is replicated-compute and passes pure_dp
+        mesh_axes=lever_axes,
         track_diagnostics=args.kfac_diagnostics,
         has_diag_a_layers=args.kfac_embedding,
         has_conv_layers=False,
@@ -230,13 +274,23 @@ def main(argv=None):
             + "\n".join(f"  [{r.name}] {r.message}" for r in bad)
         )
     # pure data-parallel runs use a one-axis mesh — the layout the
-    # owner/comm levers require; sequence parallelism adds the seq axis
-    mesh = (
-        Mesh(devices, ("data",)) if sp == 1
-        else Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
-    )
-    batch_spec = P("data") if sp == 1 else P("data", "seq")
-    dp = devices.size // sp
+    # owner/comm levers require; sequence parallelism adds the seq axis;
+    # --tensor-parallel builds the 2-D data×tensor mesh (replicated-compute
+    # tensor axis, K-FAC collectives on 'data' only)
+    if sp > 1:
+        mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
+        batch_spec = P("data", "seq")
+        dp = devices.size // sp
+    elif tp > 1:
+        from kfac_pytorch_tpu.parallel.mesh import data_tensor_mesh
+
+        mesh = data_tensor_mesh(tp, devices=devices)
+        batch_spec = P("data")
+        dp = devices.size // tp
+    else:
+        mesh = Mesh(devices, ("data",))
+        batch_spec = P("data")
+        dp = devices.size
     n_proc = launch.size()
     if dp % n_proc != 0:
         # per-process row-block slicing below assumes the data axis spans
@@ -249,7 +303,8 @@ def main(argv=None):
         )
     global_bs = args.batch_size * dp
     if launch.is_primary():
-        print(f"mesh data={dp} seq={sp} global_batch={global_bs} seq_len={args.seq_len}")
+        print(f"mesh data={dp} seq={sp} tensor={tp} "
+              f"global_batch={global_bs} seq_len={args.seq_len}")
 
     if sp > 1:
         attn = make_context_parallel_attention(
@@ -275,7 +330,8 @@ def main(argv=None):
     model = transformer_lm.get_model(
         vocab, max_len=args.seq_len, d_model=args.d_model,
         n_heads=args.n_heads, n_layers=args.n_layers, attention_fn=attn,
-        kfac_embedding=args.kfac_embedding, remat=args.remat,
+        kfac_embedding=args.kfac_embedding, qkv_lens=args.qkv_lens,
+        tie_embeddings=args.tie_embeddings, remat=args.remat,
     )
     init_toks = jnp.zeros((global_bs, args.seq_len), jnp.int32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_toks, train=True)

@@ -6,11 +6,15 @@ script is "work-in-progress and does not work with K-FAC yet"
 (pytorch_wikitext_rnn.py:6) and crashes on stale kwargs when enabled
 (SURVEY.md §2.2). The dense decoder is preconditioned; recurrent cells and
 the embedding train with plain SGD (the reference's ``known_modules``
-contract).
+contract) unless ``--kfac-embedding`` adds the diagonal-A table — which
+composes with ``--tied`` via the reduce lens (one statistics set over both
+use sites). The K-FAC perf levers and the planner profiles share the same
+flag surface as the other trainers.
 
 Run:
     python examples/train_wikitext_rnn.py --synthetic --epochs 2
     python examples/train_wikitext_rnn.py --data-dir /path/to/wikitext-2
+    python examples/train_wikitext_rnn.py --synthetic --profile production
 """
 
 from __future__ import annotations
@@ -28,7 +32,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kfac_pytorch_tpu import KFAC, KFACParamScheduler, capture
+from kfac_pytorch_tpu import (
+    KFAC,
+    EigenRefreshCadence,
+    KFACParamScheduler,
+    capture,
+    planner,
+)
+from kfac_pytorch_tpu.compile_cache import (
+    RecompileMonitor,
+    expected_step_variants,
+)
 from kfac_pytorch_tpu.models import wikitext_rnn
 from kfac_pytorch_tpu.parallel import launch
 from kfac_pytorch_tpu.training import checkpoint as ckpt
@@ -39,7 +53,7 @@ from kfac_pytorch_tpu.training.lm_step import (
     make_lm_train_step,
 )
 from kfac_pytorch_tpu.training.metrics import Metric, ScalarWriter
-from kfac_pytorch_tpu.training.step import TrainState, kfac_flags_for_step, make_sgd
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd
 
 
 def parse_args(argv=None):
@@ -61,7 +75,10 @@ def parse_args(argv=None):
     p.add_argument("--tied", action="store_true")
     p.add_argument("--kfac-embedding", action="store_true",
                    help="precondition the token embedding too (diagonal-A "
-                        "K-FAC; beyond the reference's Linear/Conv2d set)")
+                        "K-FAC; beyond the reference's Linear/Conv2d set); "
+                        "composes with --tied — the shared table then "
+                        "accumulates ONE set of statistics over both the "
+                        "lookup and the decoder use sites (reduce lens)")
     p.add_argument("--batch-size", type=int, default=20)
     p.add_argument("--bptt", type=int, default=35)
     p.add_argument("--epochs", type=int, default=40)
@@ -76,6 +93,41 @@ def parse_args(argv=None):
     p.add_argument("--stat-decay", type=float, default=0.95)
     p.add_argument("--damping", type=float, default=0.003)
     p.add_argument("--kl-clip", type=float, default=0.001)
+    # perf levers + planner, the same surface as the other trainers
+    p.add_argument("--eigh-chunks", type=int, default=1,
+                   help="pipeline the eigen refresh over this many steps "
+                        "after each --kfac-update-freq boundary; 1 = "
+                        "monolithic, bit-exact (docs/PERF.md)")
+    p.add_argument("--factor-comm-dtype", default="f32",
+                   choices=["f32", "bf16"],
+                   help="wire dtype of the bucketed K-FAC factor exchange "
+                        "(multi-device only; f32 = bitwise parity)")
+    p.add_argument("--factor-comm-freq", type=int, default=1,
+                   help="allreduce factor statistics every N capture steps "
+                        "(multi-device only; 1 = per-step, exact)")
+    p.add_argument("--factor-sharding", default="replicated",
+                   choices=["replicated", "owner"],
+                   help="owner: DP-KFAC owner-sharded curvature state — "
+                        "O(model/devices) factor memory; embedding diag-A "
+                        "factors shard as [vocab] vector slots, so "
+                        "--kfac-embedding composes (docs/PERF.md)")
+    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+                   help="curvature eigensolver (rsvd: randomized truncated "
+                        "refresh + Woodbury apply for big factor sides)")
+    p.add_argument("--solver-rank", type=int, default=128)
+    p.add_argument("--solver-auto-threshold", type=int, default=512)
+    p.add_argument("--comm-overlap", action="store_true",
+                   help="fuse the factor-statistics reduction into the "
+                        "gradient stream (multi-device only; bitwise-"
+                        "identical numerics)")
+    p.add_argument("--staleness-budget", type=int, default=0,
+                   help="bounded slip for deferred flushes / pending swaps "
+                        "(needs --factor-comm-freq > 1 or --eigh-chunks > 1)")
+    p.add_argument("--profile", default=None,
+                   choices=["safe", "memory", "production"],
+                   help="resolve the K-FAC perf levers from a named planner "
+                        "profile using this model's factor shapes; explicit "
+                        "lever flags win (docs/PLANNER.md)")
     p.add_argument("--grad-comm-dtype", default=None, choices=[None, "bf16"],
                    help="downcast the per-step data-parallel gradient mean "
                         "on the wire (the reference's --fp16-allreduce on "
@@ -116,6 +168,8 @@ def main(argv=None):
     tx = make_sgd(momentum=args.momentum, weight_decay=args.wd)
     use_kfac = args.kfac_update_freq > 0
     kfac = None
+    devices = np.asarray(jax.devices())
+    mesh = None
     if use_kfac:
         layers = capture.discover_layers(model, tokens0, train=True)
         if not layers:
@@ -124,6 +178,41 @@ def main(argv=None):
             use_kfac = False
         else:
             print(f"K-FAC layers: {layers}")
+            # CLI lever composition routed through the planner's validity
+            # matrix, same as the transformer trainer — refusals carry the
+            # matrix's reasons instead of ad-hoc SystemExits
+            cli_plan = planner.Plan(
+                eigh_chunks=args.eigh_chunks,
+                factor_comm_dtype=args.factor_comm_dtype,
+                factor_comm_freq=args.factor_comm_freq,
+                solver=args.solver,
+                solver_rank=args.solver_rank,
+                solver_auto_threshold=args.solver_auto_threshold,
+                factor_sharding=args.factor_sharding,
+                comm_overlap=args.comm_overlap,
+                staleness_budget=args.staleness_budget,
+            )
+            lever_env = planner.PlanEnv(
+                world=int(devices.size),
+                mesh_axes=("data",) if devices.size > 1 else (),
+                has_diag_a_layers=args.kfac_embedding,
+                has_conv_layers=False,
+                fac_update_freq=max(1, args.kfac_cov_update_freq),
+                kfac_update_freq=max(1, args.kfac_update_freq),
+            )
+            bad = planner.violations(cli_plan, lever_env)
+            if bad:
+                raise SystemExit(
+                    "invalid K-FAC lever composition:\n"
+                    + "\n".join(f"  [{r.name}] {r.message}" for r in bad)
+                )
+            if devices.size > 1:
+                from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+
+                mesh = data_parallel_mesh()
+            profile_shapes = None
+            if args.profile:
+                profile_shapes = planner.model_facts(params, layers=layers)
             kfac = KFAC(
                 layers=layers,
                 factor_decay=args.stat_decay,
@@ -131,7 +220,25 @@ def main(argv=None):
                 kl_clip=args.kl_clip,
                 fac_update_freq=args.kfac_cov_update_freq,
                 kfac_update_freq=args.kfac_update_freq,
+                mesh=mesh,
+                eigh_chunks=args.eigh_chunks,
+                factor_comm_dtype=args.factor_comm_dtype,
+                factor_comm_freq=args.factor_comm_freq,
+                solver=args.solver,
+                solver_rank=args.solver_rank,
+                solver_auto_threshold=args.solver_auto_threshold,
+                factor_sharding=args.factor_sharding,
+                comm_overlap=args.comm_overlap,
+                staleness_budget=args.staleness_budget,
+                profile=args.profile,
+                profile_shapes=profile_shapes,
             )
+            if kfac.plan is not None:
+                drop = (
+                    f" (dropped: {', '.join(kfac.plan_dropped)})"
+                    if kfac.plan_dropped else ""
+                )
+                print(kfac.plan.describe() + drop)
 
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
@@ -148,34 +255,74 @@ def main(argv=None):
         # pytorch_imagenet_resnet.py:136-140) — differing start epochs
         # would desync the per-step collectives
         resume_from_epoch = int(launch.broadcast_host_value(resume_from_epoch))
+    if kfac is not None and kfac.owner_sharded:
+        # owner-mode placement contract: factor/eigen shards on their
+        # owners (re-homing a restored checkpoint), the rest replicated
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if args.grad_comm_dtype:
+        kstate = ckpt.rehome_kfac_state(kfac, state.kfac_state)
+        state = state.replace(kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(kfac_state=kstate)
+
+    if args.grad_comm_dtype and mesh is None:
         from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
 
-        comm_mesh = data_parallel_mesh()
-        if args.batch_size % comm_mesh.devices.size:
+        mesh = data_parallel_mesh()
+    if mesh is not None and (kfac is None or not kfac.owner_sharded):
+        # Commit the state to the mesh up front (replicated), like the
+        # transformer trainer: a step whose K-FAC plane carries a mesh
+        # returns mesh-committed arrays, so feeding uncommitted inputs on
+        # the first call (and uncommitted carries each epoch) would retrace
+        # every flag variant once more after the placements settle.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+    comm_active = (
+        kfac is not None
+        and kfac.factor_comm is not None
+        and kfac.factor_comm.active
+    )
+    if (args.grad_comm_dtype or comm_active) and mesh is not None:
+        if args.batch_size % mesh.devices.size:
             raise SystemExit(
-                f"--grad-comm-dtype shards the batch over {comm_mesh.devices.size} "
-                f"devices; --batch-size {args.batch_size} must divide evenly"
+                f"the sharded train step splits the batch over "
+                f"{mesh.devices.size} devices; --batch-size "
+                f"{args.batch_size} must divide evenly"
             )
-    else:
-        comm_mesh = None
     train_step = make_lm_train_step(
-        model, tx, kfac, grad_clip=args.clip, mesh=comm_mesh,
+        model, tx, kfac, grad_clip=args.clip,
+        mesh=mesh if args.grad_comm_dtype else None,
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
     )
     eval_step = make_lm_eval_step(model)
 
     writer = ScalarWriter(args.log_dir)
+    recompiles = RecompileMonitor()
+    recompiles.watch("train_step", train_step, expected_step_variants(kfac))
     step = int(jax.device_get(state.step))
     rng = jax.random.PRNGKey(args.seed)
+    # host-side refresh cadence: identical to kfac_flags_for_step at
+    # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
+    cadence = EigenRefreshCadence(kfac)
+
+    def fresh_carry():
+        # zero carry for an epoch start, committed to the mesh so epoch
+        # boundaries don't introduce a mixed committed/uncommitted input
+        # signature (one spurious train_step retrace per epoch otherwise)
+        carry = init_carry(model, jax.device_get(state.params), tokens0)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            carry = jax.device_put(carry, NamedSharding(mesh, P()))
+        return carry
 
     for epoch in range(resume_from_epoch, args.epochs):
         lr = args.base_lr
         for e in args.lr_decay:
             if epoch >= e:
                 lr *= 0.25  # torch LM convention: anneal lr /4 at plateaus
-        carry = init_carry(model, jax.device_get(state.params), tokens0)
+        carry = fresh_carry()
         loss_m = Metric("train/loss")
         t0 = time.perf_counter()
         n_steps = 0
@@ -185,7 +332,7 @@ def main(argv=None):
             if args.steps_per_epoch and i >= args.steps_per_epoch:
                 break
             rng, sub = jax.random.split(rng)
-            flags = kfac_flags_for_step(step, kfac, epoch)
+            flags = cadence.flags_for_step(step, epoch)
             state, carry, metrics = train_step(
                 state, (jnp.asarray(xb), jnp.asarray(yb)), carry, sub,
                 jnp.float32(lr), jnp.float32(kfac.hparams.damping if kfac else 0.0),
@@ -200,8 +347,12 @@ def main(argv=None):
               f"lr={lr:.2f} ({n_steps} steps, {dt:.1f}s)")
         writer.add_scalar("train/loss", loss_m.avg, epoch)
         writer.add_scalar("train/ppl", ppl, epoch)
+        excess = recompiles.check()
+        if excess:
+            print(f"  WARNING: unexpected recompiles (jit cache over "
+                  f"budget): {excess}")
 
-        vcarry = init_carry(model, jax.device_get(state.params), tokens0)
+        vcarry = fresh_carry()
         vl = Metric("val/loss")
         for xb, yb in data_lib.bptt_batches(val_stream, args.bptt):
             m, vcarry = eval_step(state, (jnp.asarray(xb), jnp.asarray(yb)), vcarry)

@@ -15,7 +15,13 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 
-from kfac_pytorch_tpu.models.layers import A_CONTRIB, OUT_PERTURB
+from kfac_pytorch_tpu.models.layers import (
+    A_CONTRIB,
+    A_SPLIT,
+    G_TIED,
+    OUT_PERTURB,
+    OUT_TIED,
+)
 from kfac_pytorch_tpu.ops import factor_kernels, factors
 
 PyTree = Any
@@ -28,6 +34,13 @@ PyTree = Any
 # unambiguous.
 GROUP_SEP = "#g"
 
+# Expand-lens pseudo-layer naming: a KFACDense with lens_splits=S (fused
+# QKV) sows a stacked [S, a, a] A contribution under ``a_lens`` and expands
+# into "path#s0".."path#s{S-1}". Unlike grouped convs (which partition BOTH
+# factor sides), a lens split shares the full A side and partitions only the
+# output/G side into features/S columns.
+SPLIT_SEP = "#s"
+
 
 def split_group_name(name: str) -> Tuple[str, Any]:
     """``"path#g3" -> ("path", 3)``; ungrouped ``"path" -> ("path", None)``."""
@@ -37,6 +50,22 @@ def split_group_name(name: str) -> Tuple[str, Any]:
     return base, int(idx)
 
 
+def split_lens_name(name: str) -> Tuple[str, Any]:
+    """``"path#s2" -> ("path", 2)``; unsplit ``"path" -> ("path", None)``."""
+    base, sep, idx = name.rpartition(SPLIT_SEP)
+    if not sep:
+        return name, None
+    return base, int(idx)
+
+
+def layer_base(name: str) -> str:
+    """Module path with any pseudo-layer suffix (``#gK``/``#sK``) stripped."""
+    base, gi = split_group_name(name)
+    if gi is not None:
+        return base
+    return split_lens_name(name)[0]
+
+
 def group_counts(names: List[str]) -> Dict[str, int]:
     """``{base_path: G}`` for every grouped base present in ``names``."""
     counts: Dict[str, int] = {}
@@ -44,6 +73,16 @@ def group_counts(names: List[str]) -> Dict[str, int]:
         base, gi = split_group_name(n)
         if gi is not None:
             counts[base] = max(counts.get(base, 0), gi + 1)
+    return counts
+
+
+def lens_counts(names: List[str]) -> Dict[str, int]:
+    """``{base_path: S}`` for every lens-split base present in ``names``."""
+    counts: Dict[str, int] = {}
+    for n in names:
+        base, si = split_lens_name(n)
+        if si is not None:
+            counts[base] = max(counts.get(base, 0), si + 1)
     return counts
 
 
@@ -85,21 +124,28 @@ def layer_names_from_capture(captured: PyTree) -> List[str]:
 
     A rank-3 contribution ``[G, a, a]`` marks a grouped conv, expanded into
     G ``path#gK`` pseudo-layers (rank 2 = dense/conv, rank 1 = embedding
-    diagonal).
+    diagonal). An ``a_lens`` contribution ``[S, a, a]`` marks an expand-lens
+    dense layer (fused QKV), expanded into S ``path#sK`` pseudo-layers.
     """
     names = []
     for keys, leaf in _flatten_with_paths(captured):
-        if keys[-1] == A_CONTRIB or (
-            len(keys) >= 2 and keys[-2] == A_CONTRIB
-        ):  # sow may wrap the leaf in a tuple (path gains an index key)
-            name = "/".join(keys[: -1 if keys[-1] == A_CONTRIB else -2])
-            if len(getattr(leaf, "shape", ())) == 3:
-                expanded = [f"{name}{GROUP_SEP}{k}" for k in range(leaf.shape[0])]
-            else:
-                expanded = [name]
-            for n in expanded:
-                if n not in names:
-                    names.append(n)
+        # sow may wrap the leaf in a tuple (path gains an index key)
+        key = keys[-1] if keys[-1] in (A_CONTRIB, A_SPLIT) else (
+            keys[-2] if len(keys) >= 2 and keys[-2] in (A_CONTRIB, A_SPLIT)
+            else None
+        )
+        if key is None:
+            continue
+        name = "/".join(keys[: -1 if keys[-1] == key else -2])
+        if key == A_SPLIT:
+            expanded = [f"{name}{SPLIT_SEP}{k}" for k in range(leaf.shape[0])]
+        elif len(getattr(leaf, "shape", ())) == 3:
+            expanded = [f"{name}{GROUP_SEP}{k}" for k in range(leaf.shape[0])]
+        else:
+            expanded = [name]
+        for n in expanded:
+            if n not in names:
+                names.append(n)
     return names
 
 
@@ -133,23 +179,30 @@ def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndar
 
     Grouped pseudo-layers get their group's output-channel slice of the
     kernel/bias grads (a grouped HWIO kernel's O axis is partitioned by
-    group; its I axis is already per-group).
+    group; its I axis is already per-group). Lens-split pseudo-layers get
+    their ``features/S`` column slice of the dense kernel/bias grads.
     """
     counts = group_counts(names)
+    s_counts = lens_counts(names)
     out = {}
     for name in names:
         base, gi = split_group_name(name)
+        si = None
+        if gi is None:
+            base, si = split_lens_name(name)
         node = _get_path(grads, base)
         if "embedding" in node:
             out[name] = {"embedding": node["embedding"]}
             continue
         kernel = node["kernel"]
         bias = node.get("bias")
-        if gi is not None:
-            co_g = kernel.shape[-1] // counts[base]
-            kernel = kernel[..., gi * co_g:(gi + 1) * co_g]
+        if gi is not None or si is not None:
+            n_parts = counts[base] if gi is not None else s_counts[base]
+            idx = gi if gi is not None else si
+            co_g = kernel.shape[-1] // n_parts
+            kernel = kernel[..., idx * co_g:(idx + 1) * co_g]
             if bias is not None:
-                bias = bias[gi * co_g:(gi + 1) * co_g]
+                bias = bias[idx * co_g:(idx + 1) * co_g]
         entry = {"kernel": kernel}
         if bias is not None:
             entry["bias"] = bias
@@ -157,11 +210,27 @@ def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndar
     return out
 
 
-def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
+def _unwrap_sown(leaf: Any) -> Any:
+    # sow reduce_fn=overwrite still wraps the value in a 1-tuple.
+    return leaf[-1] if isinstance(leaf, tuple) else leaf
+
+
+def a_contribs(
+    captured: PyTree,
+    names: List[str],
+    *,
+    perturb_grads: PyTree = None,
+    batch_averaged: bool = True,
+) -> Dict[str, jnp.ndarray]:
     """Pull per-layer A-factor contributions from the ``kfac_acts`` collection.
 
     Grouped pseudo-layers read their row of the stacked ``[G, a, a]``
-    contribution.
+    contribution; lens-split pseudo-layers read their row of the ``a_lens``
+    stack. A tied embedding/output head (its capture node carries
+    ``g_tied``) additionally folds the decoder site's logit grad-output
+    DIAGONAL into the [vocab] A diagonal — which needs the perturbation
+    cotangents, so tied models must pass ``perturb_grads`` (and the same
+    ``batch_averaged`` the G side uses).
     """
     counts = group_counts(names)
     # one pass over names (not one per grouped entry — that was O(N^2) at
@@ -172,14 +241,52 @@ def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
         b, g = split_group_name(n)
         if g is not None:
             present_counts[b] = present_counts.get(b, 0) + 1
+    s_counts = lens_counts(names)
+    s_present: Dict[str, int] = {}
+    for n in names:
+        b, s = split_lens_name(n)
+        if s is not None:
+            s_present[b] = s_present.get(b, 0) + 1
     out = {}
     for name in names:
         base, gi = split_group_name(name)
-        leaf = _get_path(captured, base)[A_CONTRIB]
-        # sow reduce_fn=overwrite still wraps the value in a 1-tuple.
-        if isinstance(leaf, tuple):
-            leaf = leaf[-1]
         if gi is None:
+            sbase, si = split_lens_name(name)
+            if si is not None:
+                node = _get_path(captured, sbase)
+                leaf = _unwrap_sown(node[A_SPLIT])
+                if (
+                    s_counts[sbase] != leaf.shape[0]
+                    or s_present[sbase] != leaf.shape[0]
+                ):
+                    raise ValueError(
+                        f"lens-split layer {sbase!r}: layer list carries "
+                        f"{s_present[sbase]} pseudo-layers (max index "
+                        f"{s_counts[sbase] - 1}) but the layer has "
+                        f"{leaf.shape[0]} splits — keep all "
+                        f"'{SPLIT_SEP}K' entries of a split layer together"
+                    )
+                out[name] = leaf[si]
+                continue
+        node = _get_path(captured, base)
+        leaf = _unwrap_sown(node[A_CONTRIB])
+        if gi is None:
+            if G_TIED in node:
+                # Reduce lens: the decoder site's [vocab] grad-output
+                # diagonal joins the embed site's token-frequency diagonal
+                # — ONE shared statistic for the tied table.
+                if perturb_grads is None:
+                    raise ValueError(
+                        f"layer {base!r} carries tied-head statistics "
+                        f"({G_TIED!r}) but a_contribs was called without "
+                        "perturb_grads — the decoder-site diagonal needs "
+                        "the logit cotangent"
+                    )
+                tied_g = _get_path(perturb_grads, base)[OUT_TIED]
+                out[name] = leaf + factors.compute_g_diag(
+                    tied_g.astype(jnp.float32), batch_averaged=batch_averaged
+                )
+                continue
             if len(getattr(leaf, "shape", ())) == 3:
                 # a stacked [G, a, a] contribution reached a non-expanded
                 # name: KFAC was built with a plain layer list (e.g.
@@ -213,13 +320,21 @@ def a_contribs(captured: PyTree, names: List[str]) -> Dict[str, jnp.ndarray]:
 
 
 def g_factors(
-    perturb_grads: PyTree, names: List[str], batch_averaged: bool
+    perturb_grads: PyTree,
+    names: List[str],
+    batch_averaged: bool,
+    *,
+    captured: PyTree = None,
 ) -> Dict[str, jnp.ndarray]:
     """G factors from ∂L/∂(layer output) cotangents.
 
     Rank dispatch replaces the reference's isinstance dispatch
     (kfac/utils.py:144-153): rank-4 cotangents are conv outputs (NHWC),
-    rank-2/3 are dense outputs (possibly with a time axis).
+    rank-2/3 are dense outputs (possibly with a time axis). Lens-split
+    pseudo-layers compute their G from their ``features/S`` column slice of
+    the fused cotangent (sliced with the same compute as an unfused layer —
+    parity is bitwise). Tied heads fold the decoder site's sown query
+    covariance (``g_tied``, in ``captured``) into the embed site's G.
     """
     counts = group_counts(names)
     # a grouped conv's output channels are partitioned by group; each
@@ -234,13 +349,22 @@ def g_factors(
         )
         for base, n_groups in counts.items()
     }
+    s_counts = lens_counts(names)
     out = {}
     for name in names:
         base, gi = split_group_name(name)
         if gi is not None:
             out[name] = stacked[base][gi]
             continue
-        g = _get_path(perturb_grads, name)[OUT_PERTURB]
+        base, si = split_lens_name(name)
+        g = _get_path(perturb_grads, base)[OUT_PERTURB]
+        if si is not None:
+            m = g.shape[-1] // s_counts[base]
+            out[name] = factors.compute_g_dense(
+                g[..., si * m:(si + 1) * m].astype(jnp.float32),
+                batch_averaged=batch_averaged,
+            )
+            continue
         if g.ndim == 4:
             out[name] = factors.compute_g_conv(
                 g.astype(jnp.float32), batch_averaged=batch_averaged
@@ -249,6 +373,10 @@ def g_factors(
             out[name] = factors.compute_g_dense(
                 g.astype(jnp.float32), batch_averaged=batch_averaged
             )
+            if captured is not None:
+                cap_node = _get_path(captured, base)
+                if G_TIED in cap_node:
+                    out[name] = out[name] + _unwrap_sown(cap_node[G_TIED])
     return out
 
 
@@ -298,10 +426,15 @@ def write_back(
 
     grads = _deep_copy(grads)
     grouped: Dict[str, Dict[int, jnp.ndarray]] = {}
+    lensed: Dict[str, Dict[int, jnp.ndarray]] = {}
     for name, mat in updates.items():
         base, gi = split_group_name(name)
         if gi is not None:
             grouped.setdefault(base, {})[gi] = mat
+            continue
+        base, si = split_lens_name(name)
+        if si is not None:
+            lensed.setdefault(base, {})[si] = mat
             continue
         node = _get_path(grads, name)
         if "embedding" in node:
@@ -335,6 +468,31 @@ def write_back(
             sub = factors.mat_to_grads(
                 parts[gi] * nu, (kh, kw, ci_g, co_g), has_bias
             )
+            kernels.append(sub["kernel"])
+            if has_bias:
+                biases.append(sub["bias"])
+        node["kernel"] = jnp.concatenate(kernels, axis=-1).astype(
+            node["kernel"].dtype
+        )
+        if has_bias:
+            node["bias"] = jnp.concatenate(biases).astype(node["bias"].dtype)
+    for base, parts in lensed.items():
+        # reassemble the per-split [m, a] updates along the fused kernel's
+        # column axis — the exact inverse of layer_grads' column slicing
+        node = _get_path(grads, base)
+        cin, cout = node["kernel"].shape
+        n_splits = max(parts) + 1
+        if len(parts) != n_splits:
+            raise ValueError(
+                f"lens-split layer {base!r}: updates carry {len(parts)} of "
+                f"{n_splits} pseudo-layer splits — keep all '{SPLIT_SEP}K' "
+                "entries of a split layer together"
+            )
+        m = cout // n_splits
+        has_bias = "bias" in node
+        kernels, biases = [], []
+        for si in range(n_splits):
+            sub = factors.mat_to_grads(parts[si] * nu, (cin, m), has_bias)
             kernels.append(sub["kernel"])
             if has_bias:
                 biases.append(sub["bias"])

@@ -45,6 +45,16 @@ PERTURBATIONS = "perturbations"
 # Variable names inside a layer's path.
 A_CONTRIB = "a"
 OUT_PERTURB = "out"
+# Expand-lens capture (fused QKV): a [S, a, a] stack of identical A
+# contributions under its own name — rank-3 under A_CONTRIB already means
+# grouped conv, and the G-side treatment differs (column slicing vs
+# per-group slicing), so the split capture is a distinct variable.
+A_SPLIT = "a_lens"
+# Reduce-lens capture (tied embedding/output head): the decoder site's
+# extra statistics, sown at the SAME module path as the embed site so the
+# shared table accumulates both uses once.
+G_TIED = "g_tied"
+OUT_TIED = "out_tied"
 
 
 def _overwrite(old: Any, new: Any) -> Any:
@@ -76,12 +86,12 @@ class _KFACLayer(nn.Module):
         if self._capturing():
             self.sow(KFAC_ACTS, A_CONTRIB, contrib_fn(), reduce_fn=_overwrite)
 
-    def _maybe_perturb(self, y: jnp.ndarray) -> jnp.ndarray:
+    def _maybe_perturb(self, y: jnp.ndarray, name: str = OUT_PERTURB) -> jnp.ndarray:
         # Gate so the model also applies cleanly WITHOUT a perturbations
         # collection (eval / plain SGD steps): flax's Module.perturb would
         # require the collection to exist.
-        if self.is_initializing() or self.has_variable(PERTURBATIONS, OUT_PERTURB):
-            return self.perturb(OUT_PERTURB, y)
+        if self.is_initializing() or self.has_variable(PERTURBATIONS, name):
+            return self.perturb(name, y)
         return y
 
 
@@ -93,10 +103,20 @@ class KFACDense(_KFACLayer):
     Inputs of rank > 2 (e.g. ``[B, T, d]``) are supported — factor math
     flattens leading axes, matching how the reference's LM decoder flattens
     tokens.
+
+    ``lens_splits = S > 1`` turns on the expand Kronecker lens for fused
+    multi-head projections (e.g. one [m, 3m] QKV matmul): the layer is
+    captured as S independent ``name#sK`` pseudo-layers, each with the
+    shared input-side A factor and its own ``features/S``-side G factor.
+    The forward matmul stays fused; only the curvature model splits —
+    refresh cost drops from one (3m)³ eigh to three m³ eighs (~9×) and the
+    factors land in existing shape buckets (*KFAC for Modern Neural Network
+    Architectures*, arxiv 2311.00636).
     """
 
     features: int
     use_bias: bool = True
+    lens_splits: int = 1
     dtype: Optional[Dtype] = None
     param_dtype: Dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
@@ -104,6 +124,11 @@ class KFACDense(_KFACLayer):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.lens_splits > 1 and self.features % self.lens_splits:
+            raise ValueError(
+                f"lens_splits={self.lens_splits} must divide "
+                f"features={self.features}"
+            )
         kernel = self.param(
             "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
         )
@@ -112,11 +137,30 @@ class KFACDense(_KFACLayer):
         else:
             bias = None
 
-        self._sow_a(
-            lambda: factors.compute_a_dense(
-                x.astype(jnp.float32), has_bias=self.use_bias
+        if self.lens_splits > 1:
+            # Expand lens (fused QKV): the layer is S narrow projections
+            # sharing one input, so every pseudo-layer's A factor is the
+            # SAME matrix — sow it once, broadcast-stacked [S, a, a] so
+            # capture.py can read S off the leaf and expand ``name#sK``
+            # pseudo-layers. XLA CSEs the broadcast; no extra matmul.
+            if self._capturing():
+                contrib = factors.compute_a_dense(
+                    x.astype(jnp.float32), has_bias=self.use_bias
+                )
+                self.sow(
+                    KFAC_ACTS,
+                    A_SPLIT,
+                    jnp.broadcast_to(
+                        contrib[None], (self.lens_splits,) + contrib.shape
+                    ),
+                    reduce_fn=_overwrite,
+                )
+        else:
+            self._sow_a(
+                lambda: factors.compute_a_dense(
+                    x.astype(jnp.float32), has_bias=self.use_bias
+                )
             )
-        )
 
         x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
         y = jnp.matmul(x, kernel)
@@ -146,18 +190,56 @@ class KFACEmbed(_KFACLayer):
         1.0, "fan_in", "normal", out_axis=0
     )
 
-    @nn.compact
-    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
-        table = self.param(
+    def setup(self):
+        # setup-style (not @nn.compact) so the table is shared between
+        # __call__ and attend — the reduce lens for tied embedding/output
+        # heads needs both methods on one module instance.
+        self.embedding = self.param(
             "embedding",
             self.embedding_init,
             (self.num_embeddings, self.features),
             self.param_dtype,
         )
-        self._sow_a(lambda: factors.compute_a_embed(ids, self.num_embeddings))
-        (table,) = nn.dtypes.promote_dtype(table, dtype=self.dtype)
+
+    def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
+        # Diagonal-A capture routes through the factor-kernel dispatcher:
+        # scatter-add bincount by default, the fused Pallas token-gather
+        # kernel when the train step opened a "pallas" scope.
+        self._sow_a(
+            lambda: factor_kernels.dispatch_compute_a_embed(
+                ids, self.num_embeddings
+            )
+        )
+        (table,) = nn.dtypes.promote_dtype(self.embedding, dtype=self.dtype)
         y = jnp.take(table, ids, axis=0)
         return self._maybe_perturb(y)
+
+    def attend(self, query: jnp.ndarray) -> jnp.ndarray:
+        """Tied decoder head: ``logits = query @ tableᵀ`` with reduce-lens
+        capture.
+
+        Drop-in for ``flax.linen.Embed.attend``. The decoder site reuses the
+        shared table as a [features, vocab] projection, so its Kronecker
+        statistics fold into the embed site's factors ONCE (weight-shared
+        "reduce" setting, arxiv 2311.00636): the query input covariance
+        (sown here as ``g_tied``) adds to the [features] G side, and the
+        logit grad-output diagonal (via the ``out_tied`` perturbation,
+        reduced in capture.py) adds to the [vocab] diagonal A side.
+        """
+        if self._capturing():
+            self.sow(
+                KFAC_ACTS,
+                G_TIED,
+                factors.compute_a_dense(
+                    query.astype(jnp.float32), has_bias=False
+                ),
+                reduce_fn=_overwrite,
+            )
+        query, table = nn.dtypes.promote_dtype(
+            query, self.embedding, dtype=self.dtype
+        )
+        y = jnp.matmul(query, table.T)
+        return self._maybe_perturb(y, OUT_TIED)
 
 
 class KFACConv(_KFACLayer):

@@ -35,6 +35,11 @@ class TransformerBlock(nn.Module):
     d_ff: int
     attention_fn: AttentionFn = full_attention
     dropout: float = 0.0
+    # Expand-lens on the fused QKV projection (arxiv 2311.00636): capture
+    # three d_model-side G factors for the column slices instead of one
+    # 3·d_model-side factor — ~9× lighter eigendecompositions, and the
+    # factors land in the same shape buckets as the other projections.
+    qkv_lens: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -42,7 +47,11 @@ class TransformerBlock(nn.Module):
         hd = self.d_model // self.n_heads
 
         h = nn.LayerNorm(name="ln_attn")(x)
-        qkv = KFACDense(3 * self.d_model, name="qkv")(h)
+        qkv = KFACDense(
+            3 * self.d_model,
+            name="qkv",
+            lens_splits=3 if self.qkv_lens else 1,
+        )(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (b, t, self.n_heads, hd)
         a = self.attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape),
@@ -78,6 +87,15 @@ class TransformerLM(nn.Module):
     # they act as per-position biases and their "input distribution" is a
     # constant arange.
     kfac_embedding: bool = False
+    # Expand-lens on every block's fused QKV projection (see
+    # TransformerBlock.qkv_lens).
+    qkv_lens: bool = False
+    # Weight tying: the decoder head reuses the token-embedding table
+    # (logits = x · Wᵀ). With kfac_embedding=True the tied table gets ONE
+    # set of K-FAC statistics accumulated over both use sites (the reduce
+    # setting of arxiv 2311.00636): the decoder input joins the m-side G
+    # factor and the logits' grad diagonal joins the vocab-side A diagonal.
+    tie_embeddings: bool = False
     # Rematerialize each block in the backward pass (jax.checkpoint via
     # nn.remat): residual activation memory drops from O(n_layers · B·T·D)
     # to O(B·T·D) + per-block recompute — the standard HBM↔FLOPs trade for
@@ -97,7 +115,8 @@ class TransformerLM(nn.Module):
                 "(out-of-range position embeddings would be silently NaN)"
             )
         embed_cls = KFACEmbed if self.kfac_embedding else nn.Embed
-        x = embed_cls(self.vocab_size, self.d_model, name="tok_embed")(tokens)
+        embed = embed_cls(self.vocab_size, self.d_model, name="tok_embed")
+        x = embed(tokens)
         pos = nn.Embed(self.max_len, self.d_model, name="pos_embed")(
             jnp.arange(t)[None, :]
         )
@@ -113,9 +132,12 @@ class TransformerLM(nn.Module):
                 d_ff=self.d_ff or 4 * self.d_model,
                 attention_fn=self.attention_fn,
                 dropout=self.dropout,
+                qkv_lens=self.qkv_lens,
                 name=f"block_{i}",
             )(x, train)
         x = nn.LayerNorm(name="ln_f")(x)
+        if self.tie_embeddings:
+            return embed.attend(x)
         return KFACDense(self.vocab_size, name="decoder")(x)
 
 
@@ -128,6 +150,8 @@ def get_model(
     attention_fn: AttentionFn = full_attention,
     dropout: float = 0.0,
     kfac_embedding: bool = False,
+    qkv_lens: bool = False,
+    tie_embeddings: bool = False,
     remat: bool = False,
 ) -> TransformerLM:
     """Factory in the style of the other zoos (models/__init__.py)."""
@@ -136,5 +160,7 @@ def get_model(
         n_heads=n_heads, n_layers=n_layers, attention_fn=attention_fn,
         dropout=dropout,
         kfac_embedding=kfac_embedding,
+        qkv_lens=qkv_lens,
+        tie_embeddings=tie_embeddings,
         remat=remat,
     )

@@ -15,10 +15,12 @@ optional weight tying. Differences, both deliberate:
   224-229) — the caller ``lax.stop_gradient``s it between segments.
 
 With ``tie_weights=True`` the decoder shares the embedding matrix
-(``Embed.attend``) and is therefore not an independent K-FAC layer — tied
-runs train the decoder via the embedding's SGD gradient, which is
-well-defined (the reference would have preconditioned a doubly-used weight
-with single-use statistics).
+(``Embed.attend``). Without ``kfac_embedding`` that tied weight trains via
+plain SGD (the reference would have preconditioned a doubly-used weight with
+single-use statistics). With ``kfac_embedding=True`` the tied pair becomes
+ONE preconditioned layer: ``KFACEmbed.attend`` captures the decoder-site
+statistics and capture.py folds both use sites into a single factor pair —
+the reduce setting of arxiv 2311.00636.
 """
 
 from __future__ import annotations
@@ -58,8 +60,9 @@ class RNNModel(nn.Module):
     tie_weights: bool = False
     # Precondition the token embedding too (KFACEmbed, diagonal-A K-FAC) —
     # beyond the reference, whose known_modules leaves embeddings to SGD.
-    # Incompatible with tie_weights (a tied decoder reads the table through
-    # Embed.attend; the lookup-side G factor does not describe that use).
+    # Composes with tie_weights: KFACEmbed.attend captures the decoder-site
+    # statistics and capture.py folds both use sites into ONE factor pair
+    # (the reduce setting of arxiv 2311.00636).
     kfac_embedding: bool = False
 
     @nn.compact
@@ -71,8 +74,6 @@ class RNNModel(nn.Module):
     ) -> Tuple[jnp.ndarray, List[Any]]:
         if self.tie_weights and self.nhid != self.ninp:
             raise ValueError("tie_weights requires nhid == ninp")
-        if self.tie_weights and self.kfac_embedding:
-            raise ValueError("kfac_embedding is incompatible with tie_weights")
         if self.kfac_embedding:
             encoder = KFACEmbed(self.ntoken, self.ninp, name="encoder")
         else:

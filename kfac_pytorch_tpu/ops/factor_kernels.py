@@ -407,6 +407,82 @@ def compute_a_conv_grouped_fused(
 
 
 # ---------------------------------------------------------------------------
+# Token-gather covariance: embedding diagonal-A statistics in O(B·T)
+# ---------------------------------------------------------------------------
+
+# Token block per grid step (ids are tiny; this bounds the [TB, TV] one-hot
+# compare tile, the only "one-hot" that ever exists — in VMEM, never HBM).
+_TOK_BLOCK = 1024
+# Vocab tile (lane-dim multiple); the output counts block per grid step.
+_VOCAB_TILE = 512
+
+
+def _token_count_kernel(ids_ref, out_ref, *, tb, tv):
+    """One grid step: bincount one token block against one vocab tile.
+
+    Grid = (nv, nb). The output block (one vocab tile of the counts row)
+    stays VMEM-resident across the whole token sweep b = 0..nb-1 (its index
+    map ignores b): zero at the first block, accumulate a [TB, TV] one-hot
+    compare-reduce each step. Padded ids carry a sentinel ≥ the padded vocab,
+    so they match no tile and contribute nothing.
+    """
+    b = pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[0, :]  # [TB] int32
+    base = pl.program_id(0) * tv
+    # 2-D iota (1-D iota fails on TPU): absolute vocab ids for this tile.
+    tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (tb, tv), 1)
+    hits = (ids[:, None] == tile_ids).astype(jnp.float32)
+    out_ref[...] += jnp.sum(hits, axis=0, keepdims=True)
+
+
+def compute_a_embed_fused(
+    ids: jnp.ndarray,
+    vocab: int,
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Drop-in for ``factors.compute_a_embed`` as a streamed Pallas bincount.
+
+    The [B·T, V] one-hot and the dense [V, V] A factor never exist: the grid
+    streams token blocks through VMEM, each step comparing one [TB] id block
+    against one vocab tile's iota and accumulating the [1, TV] hit counts in
+    the resident output block — O(B·T) work and O(B·T + V) memory. Counts
+    are integers in f32, so dividing by N afterwards reproduces the
+    scatter-add oracle bitwise.
+    """
+    flat = ids.reshape(-1).astype(jnp.int32)
+    n = flat.shape[0]
+    tb = min(_TOK_BLOCK, max(_divisor_at_most(n, _TOK_BLOCK), 1))
+    vp = -(-vocab // _VOCAB_TILE) * _VOCAB_TILE
+    nv = vp // _VOCAB_TILE
+    npad = -(-n // tb) * tb
+    # Sentinel = padded vocab: beyond every tile's iota, so padding rows are
+    # inert (and even slot `vocab`, discarded by the final slice, stays 0).
+    flat = jnp.pad(flat, (0, npad - n), constant_values=vp)
+    blocks = flat.reshape(npad // tb, tb)
+    nb = blocks.shape[0]
+
+    kernel = functools.partial(_token_count_kernel, tb=tb, tv=_VOCAB_TILE)
+    counts = pl.pallas_call(
+        kernel,
+        grid=(nv, nb),
+        in_specs=[pl.BlockSpec((1, tb), lambda v, b: (b, 0))],
+        out_specs=pl.BlockSpec((1, _VOCAB_TILE), lambda v, b: (0, v)),
+        out_shape=jax.ShapeDtypeStruct((1, vp), jnp.float32),
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=_default_interpret(interpret),
+    )(blocks)
+    return counts.reshape(-1)[:vocab] / n
+
+
+# ---------------------------------------------------------------------------
 # Dispatch (called from models/layers.py at capture-trace time)
 # ---------------------------------------------------------------------------
 
@@ -444,6 +520,21 @@ def dispatch_compute_a_conv(
             has_bias,
             kernel_dilation=kernel_dilation,
         )
+
+
+def dispatch_compute_a_embed(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """Route an embedding layer's diagonal-A contribution per the scope.
+
+    Token ids are integers — no tangent path exists, so unlike the conv
+    dispatchers no ``stop_gradient`` is needed around the pallas path.
+    """
+    tel = get_telemetry()
+    kind = active_factor_kernel()
+    tel.set_gauge("kfac/embedding_capture_kernel", 1.0 if kind == "pallas" else 0.0)
+    with tel.span("trace/kfac/factor_kernel"):
+        if kind == "pallas":
+            return compute_a_embed_fused(ids, vocab)
+        return factors.compute_a_embed(ids, vocab)
 
 
 def dispatch_compute_a_conv_grouped(

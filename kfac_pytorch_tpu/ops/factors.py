@@ -165,6 +165,23 @@ def compute_a_embed(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
     return counts / n
 
 
+def compute_a_embed_onehot(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """Dense one-hot oracle for :func:`compute_a_embed` (parity/memory baseline).
+
+    Materializes the [N, vocab] one-hot matrix and the full [vocab, vocab]
+    dense A factor, then reads its diagonal — exactly the program the
+    fast paths must never emit. Kept as the reference semantics for the
+    fused token-gather kernel (ops/factor_kernels.py) and as the memory
+    baseline for the compile-only embedding-capture regression test: the
+    fused path's temporary bytes must stay far below this one's.
+    """
+    flat = ids.reshape(-1)
+    n = flat.shape[0]
+    onehot = jax.nn.one_hot(flat, vocab, dtype=jnp.float32)
+    dense_a = jnp.matmul(onehot.T, onehot / n, precision=_HIGHEST)
+    return jnp.diagonal(dense_a)
+
+
 def compute_g_dense(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
     """Grad-output covariance for a dense layer.
 
@@ -177,6 +194,22 @@ def compute_g_dense(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
     if batch_averaged:
         return jnp.matmul(g.T, g * n, precision=_HIGHEST)
     return jnp.matmul(g.T, g / n, precision=_HIGHEST)
+
+
+def compute_g_diag(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
+    """DIAGONAL of the grad-output covariance: ``diag(GᵀG·s)`` without GᵀG.
+
+    The decoder site of a tied embedding/output head contributes grad-output
+    statistics over the [vocab] logit axis; the full [vocab, vocab] matrix is
+    as intractable as the dense embedding A factor, but the tied table's A
+    side is already stored as a diagonal, so only the diagonal of the decoder
+    contribution is needed. Scaling matches :func:`compute_g_dense` (×N when
+    batch-averaged, /N otherwise).
+    """
+    g = _flatten_leading(g)
+    n = g.shape[0]
+    scale = float(n) if batch_averaged else 1.0 / n
+    return jnp.sum(g * g, axis=0) * scale
 
 
 def compute_g_conv(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:

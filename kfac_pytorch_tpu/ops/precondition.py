@@ -557,6 +557,7 @@ def _owner_gather_layout(
     owners: Dict[str, int],
     world: int,
     rank_fn,
+    diag_a: set = frozenset(),
 ) -> Tuple[list, Dict[str, Dict[str, Any]], int]:
     """Static allgather-buffer layout for the owner-sharded solve.
 
@@ -570,19 +571,31 @@ def _owner_gather_layout(
     the table field layout, and ``per_device_elems`` is the uniform f32
     buffer width (max owned payload over devices).
     """
-    order = [n for names in shape_groups(shapes).values() for n in names]
+    order = sorted(diag_a) + [
+        n
+        for names in shape_groups(
+            {k: v for k, v in shapes.items() if k not in diag_a}
+        ).values()
+        for n in names
+    ]
     segments: Dict[str, Dict[str, Any]] = {}
     cursor = [0] * world
     for name in order:
         g, a = int(shapes[name][0]), int(shapes[name][1])
-        ra = rank_fn(a) if rank_fn is not None else None
+        diag = name in diag_a
+        ra = rank_fn(a) if rank_fn is not None and not diag else None
         rg = rank_fn(g) if rank_fn is not None else None
-        fields = [
-            ("QA", (a, ra) if ra is not None else (a, a)),
-            ("dA", (ra,) if ra is not None else (a,)),
-        ]
-        if ra is not None:
-            fields.append(("rhoA", ()))
+        if diag:
+            # diagonal-A layer: the A side is already a compact [vocab]
+            # vector; only the G side can carry a truncated basis
+            fields = [("dA", (a,))]
+        else:
+            fields = [
+                ("QA", (a, ra) if ra is not None else (a, a)),
+                ("dA", (ra,) if ra is not None else (a,)),
+            ]
+            if ra is not None:
+                fields.append(("rhoA", ()))
         fields += [
             ("QG", (g, rg) if rg is not None else (g, g)),
             ("dG", (rg,) if rg is not None else (g,)),
@@ -599,7 +612,8 @@ def _owner_gather_layout(
         update_elems = g * a
         mode = (
             "tables"
-            if (ra is not None or rg is not None) and table_elems < update_elems
+            if (diag or ra is not None or rg is not None)
+            and table_elems < update_elems
             else "update"
         )
         elems = table_elems if mode == "tables" else update_elems
@@ -624,6 +638,7 @@ def precondition_all_owner(
     plan,
     rank_fn=None,
     eigen_dtype=jnp.float32,
+    axis_name: str = None,
 ) -> Dict[str, jnp.ndarray]:
     """Owner-sharded preconditioning: solve on the owner, allgather results.
 
@@ -642,15 +657,30 @@ def precondition_all_owner(
     from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 
     axes = tuple(mesh.axis_names)
-    if len(axes) != 1:
+    if axis_name is None:
+        if len(axes) != 1:
+            raise ValueError(
+                "owner-sharded preconditioning on a multi-axis mesh needs "
+                f"an explicit axis_name; got axes {axes}"
+            )
+        axis = axes[0]
+    else:
+        if axis_name not in axes:
+            raise ValueError(
+                f"axis {axis_name!r} not in mesh axes {axes}"
+            )
+        axis = axis_name
+    if int(mesh.shape[axis]) != plan.world:
         raise ValueError(
-            "owner-sharded preconditioning requires a pure data-parallel "
-            f"mesh; got axes {axes}"
+            f"shard plan world {plan.world} != mesh axis {axis!r} size "
+            f"{int(mesh.shape[axis])}"
         )
-    axis = axes[0]
     shapes = {n: (g.shape[0], g.shape[1]) for n, g in grad_mats.items()}
+    diag_a = {
+        s.name for s in plan.slots if s.factor == "A" and s.diag
+    }
     order, segments, width = _owner_gather_layout(
-        shapes, plan.owners, plan.world, rank_fn
+        shapes, plan.owners, plan.world, rank_fn, diag_a
     )
     get_telemetry().set_gauge(
         "kfac/precond_allgather_bytes", plan.world * width * 4
@@ -661,6 +691,10 @@ def precondition_all_owner(
         out = {}
         for fac, n in (("A", a_n), ("G", g_n)):
             slot = plan.slot(name, fac)
+            if slot.diag:
+                # vector group: the eigen entry is the floored diagonal
+                out[f"d{fac}"] = eshard[f"v{n}"]["d"][slot.row]
+                continue
             grp = eshard[f"n{n}"]
             out[f"Q{fac}"] = grp["Q"][slot.row]
             out[f"d{fac}"] = grp["d"][slot.row]

@@ -230,6 +230,7 @@ def plan_factor_shards(
     shapes: Dict[str, Tuple[int, int]],
     world: int,
     max_bucket_elems: int = 1 << 20,
+    diag_a: Optional[set] = None,
 ) -> "FactorShardPlan":
     """Plan the owner-sharded factor-state layout (DP-KFAC, arxiv 2206.15143).
 
@@ -253,29 +254,52 @@ def plan_factor_shards(
     elements) becomes one pseudo-leaf fed to :func:`plan_factor_buckets`,
     so the reduce-scatter fuses groups into the same ~1 Mi-element buckets
     the replicated allreduce plane uses — one collective per bucket, and
-    ``FactorBucketEntry.index`` indexes :attr:`FactorShardPlan.group_sizes`.
+    ``FactorBucketEntry.index`` indexes the concatenation
+    :attr:`FactorShardPlan.group_sizes` + :attr:`diag_group_sizes`.
+
+    ``diag_a`` names layers whose A factor is a stored DIAGONAL (embedding
+    tables): their A slot is a ``[vocab]`` vector, not a matrix, so those
+    slots live in separate ``v<size>`` groups of ``[world·rows_n, n]`` stacks
+    — n² storage for a vocab-sized side would forfeit the whole point of the
+    diagonal parameterization.
     """
-    owners = precondition_assignment(shapes, world)
+    diag_a = diag_a or set()
+    owners = precondition_assignment(shapes, world, diag_a=diag_a)
     slots: List[FactorShardSlot] = []
     counts: Dict[Tuple[int, int], int] = {}  # (size, owner) -> next row
+    vcounts: Dict[Tuple[int, int], int] = {}  # diag (size, owner) -> next row
     for name in sorted(shapes):
         g, a = shapes[name]
         for factor, size in (("A", int(a)), ("G", int(g))):
             owner = owners[name]
-            row = counts.get((size, owner), 0)
-            counts[(size, owner)] = row + 1
+            diag = factor == "A" and name in diag_a
+            table = vcounts if diag else counts
+            row = table.get((size, owner), 0)
+            table[(size, owner)] = row + 1
             slots.append(
                 FactorShardSlot(
-                    name=name, factor=factor, size=size, owner=owner, row=row
+                    name=name,
+                    factor=factor,
+                    size=size,
+                    owner=owner,
+                    row=row,
+                    diag=diag,
                 )
             )
     group_rows = {
         size: max(c for (s, _), c in counts.items() if s == size)
-        for size in {s.size for s in slots}
+        for size in {s for (s, _) in counts}
+    }
+    diag_group_rows = {
+        size: max(c for (s, _), c in vcounts.items() if s == size)
+        for size in {s for (s, _) in vcounts}
     }
     sizes = tuple(sorted(group_rows))
+    vsizes = tuple(sorted(diag_group_rows))
     wire_buckets = plan_factor_buckets(
-        [(group_rows[n] * n * n,) for n in sizes], max_bucket_elems
+        [(group_rows[n] * n * n,) for n in sizes]
+        + [(diag_group_rows[n] * n,) for n in vsizes],
+        max_bucket_elems,
     )
     return FactorShardPlan(
         world=world,
@@ -284,6 +308,8 @@ def plan_factor_shards(
         group_rows=group_rows,
         group_sizes=sizes,
         wire_buckets=wire_buckets,
+        diag_group_rows=diag_group_rows,
+        diag_group_sizes=vsizes,
     )
 
 
@@ -301,6 +327,9 @@ class FactorShardSlot:
     size: int
     owner: int
     row: int
+    # True for the A slot of a diagonal-A (embedding) layer: the slot is a
+    # [size] VECTOR living in the "v<size>" group, not an [n, n] matrix
+    diag: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -313,6 +342,10 @@ class FactorShardPlan:
     group_rows: Dict[int, int]
     group_sizes: Tuple[int, ...]
     wire_buckets: Tuple["FactorBucket", ...]
+    # diagonal-A vector groups ("v<size>" state keys); empty when no
+    # embedding layer is owner-sharded
+    diag_group_rows: Dict[int, int] = dataclasses.field(default_factory=dict)
+    diag_group_sizes: Tuple[int, ...] = ()
 
     def slot(self, name: str, factor: str) -> FactorShardSlot:
         for s in self.slots:
@@ -320,17 +353,34 @@ class FactorShardPlan:
                 return s
         raise KeyError((name, factor))
 
-    def group_slots(self, size: int) -> Tuple[FactorShardSlot, ...]:
-        return tuple(s for s in self.slots if s.size == size)
+    def group_slots(
+        self, size: int, diag: bool = False
+    ) -> Tuple[FactorShardSlot, ...]:
+        return tuple(
+            s for s in self.slots if s.size == size and s.diag == diag
+        )
 
-    def valid_rows(self, size: int) -> List[List[bool]]:
+    def valid_rows(self, size: int, diag: bool = False) -> List[List[bool]]:
         """``[world][rows]`` mask: True where a real slot lives (pad rows of
         under-loaded devices are False — excluded from spectrum-mass sums)."""
-        rows = self.group_rows[size]
+        rows = (self.diag_group_rows if diag else self.group_rows)[size]
         mask = [[False] * rows for _ in range(self.world)]
-        for s in self.group_slots(size):
+        for s in self.group_slots(size, diag):
             mask[s.owner][s.row] = True
         return mask
+
+    def wire_groups(self) -> List[Tuple[str, int, int, int]]:
+        """Bucket-entry order: ``(state_key, size, rows, elems_per_slot)``
+        for the matrix groups then the vector groups —
+        ``FactorBucketEntry.index`` indexes this list."""
+        out = [
+            (f"n{n}", n, self.group_rows[n], n * n) for n in self.group_sizes
+        ]
+        out += [
+            (f"v{n}", n, self.diag_group_rows[n], n)
+            for n in self.diag_group_sizes
+        ]
+        return out
 
     def owner_count(self) -> int:
         return len({s.owner for s in self.slots})
@@ -366,11 +416,22 @@ def shard_plan_bytes(
         q, d, rho = eigen_elems(n)
         factor_local += rows * n * n * 4
         eigen_local += rows * (q * eigen_itemsize + d * 4 + rho * 4)
+    for n in plan.diag_group_sizes:
+        # diagonal-A vector groups: the factor is the [n] vector and the
+        # eigen entry is just the floored copy — no Q, no rho
+        rows = plan.diag_group_rows[n]
+        factor_local += rows * n * 4
+        eigen_local += rows * n * 4
     per_owner = [0] * plan.world
     replicated_total = 0
     for s in plan.slots:
-        q, d, rho = eigen_elems(s.size)
-        slot_bytes = s.size * s.size * 4 + q * eigen_itemsize + d * 4 + rho * 4
+        if s.diag:
+            slot_bytes = s.size * 4 * 2  # vector factor + vector eigen
+        else:
+            q, d, rho = eigen_elems(s.size)
+            slot_bytes = (
+                s.size * s.size * 4 + q * eigen_itemsize + d * 4 + rho * 4
+            )
         per_owner[s.owner] += slot_bytes
         replicated_total += slot_bytes
     return {

@@ -194,7 +194,14 @@ class FactorComm:
 
     @property
     def multi_device(self) -> bool:
-        return self.mesh is not None and self.mesh.devices.size > 1
+        """More than one replica along the FACTOR axis. On a 2-D data×tensor
+        mesh only the data axis carries K-FAC collectives, so a mesh that is
+        multi-device purely in its tensor axis leaves the plane inert."""
+        if self.mesh is None:
+            return False
+        if self.axis_name in self.mesh.shape:
+            return int(self.mesh.shape[self.axis_name]) > 1
+        return self.mesh.devices.size > 1
 
     @property
     def defer(self) -> bool:
@@ -380,23 +387,25 @@ class FactorComm:
         self.last_wire_bytes = wire
         self.last_collectives = len(plan.wire_buckets)
 
+        # wire-group order (matrix stacks then diagonal-A vector stacks) —
+        # FactorBucketEntry.index indexes this list
+        wgroups = plan.wire_groups()
+
         def _body(payload, shard, decay):
-            groups: Dict[int, jnp.ndarray] = {}
-            for n in plan.group_sizes:
-                rows = plan.group_rows[n]
-                flat = jnp.zeros((world * rows, n * n), jnp.float32)
-                for s in plan.group_slots(n):
+            groups: Dict[str, jnp.ndarray] = {}
+            for key, n, rows, elems in wgroups:
+                flat = jnp.zeros((world * rows, elems), jnp.float32)
+                for s in plan.group_slots(n, diag=key.startswith("v")):
                     leaf = payload[s.name][s.factor].astype(jnp.float32)
                     flat = flat.at[s.owner * rows + s.row].set(
                         leaf.reshape(-1)
                     )
-                groups[n] = flat.reshape(world, rows * n * n)
+                groups[key] = flat.reshape(world, rows * elems)
             new_shard = dict(shard)
             with get_telemetry().span("trace/kfac/factor_comm"):
                 for bucket in plan.wire_buckets:
                     parts = [
-                        groups[plan.group_sizes[e.index]]
-                        for e in bucket.entries
+                        groups[wgroups[e.index][0]] for e in bucket.entries
                     ]
                     buf = (
                         parts[0]
@@ -410,12 +419,13 @@ class FactorComm:
                     )
                     red = red[0].astype(jnp.float32) / world
                     for e in bucket.entries:
-                        n = plan.group_sizes[e.index]
-                        rows = plan.group_rows[n]
+                        key, n, rows, _ = wgroups[e.index]
                         seg = red[e.offset : e.offset + e.size]
-                        key = f"n{n}"
-                        new_shard[key] = decay * shard[key] + seg.reshape(
+                        shape = (rows, n) if key.startswith("v") else (
                             rows, n, n
+                        )
+                        new_shard[key] = decay * shard[key] + seg.reshape(
+                            shape
                         )
             return new_shard
 

@@ -28,6 +28,45 @@ def data_parallel_mesh(
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def data_tensor_mesh(
+    tensor_parallel: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = "data",
+    tensor_axis_name: str = "tensor",
+) -> Mesh:
+    """2-D ``data × tensor`` mesh: batch shards over ``axis_name``, the
+    ``tensor*`` axis is reserved for replicated-compute tensor parallelism.
+
+    The K-FAC planes (factor buckets, owner sharding, the preconditioned-grad
+    allgather) ride ONLY the data axis — everything K-FAC stores is annotated
+    ``P()`` or ``P(axis_name)``, so the tensor axis sees no factor
+    collectives (pinned by ``scripts/check_collective_count.py``). The
+    ``tensor`` prefix is the convention the mesh validators key on
+    (``training.step.require_pure_dp_mesh``): those axes must carry whole
+    examples, which replicated compute guarantees.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if tensor_parallel < 1 or devices.size % tensor_parallel:
+        raise ValueError(
+            f"tensor_parallel={tensor_parallel} does not divide "
+            f"{devices.size} devices"
+        )
+    if not tensor_axis_name.startswith("tensor"):
+        raise ValueError(
+            "the tensor axis must be named 'tensor*' — the mesh validators "
+            f"key on the prefix; got {tensor_axis_name!r}"
+        )
+    grid = devices.reshape(devices.size // tensor_parallel, tensor_parallel)
+    return Mesh(grid, (axis_name, tensor_axis_name))
+
+
+def data_axis_size(mesh: Mesh, axis_name: str = "data") -> int:
+    """Replica count along the batch axis (the K-FAC ``world``)."""
+    return int(mesh.shape[axis_name]) if axis_name in mesh.shape else 1
+
+
 def put_global_batch(mesh: Mesh, batch, axis_name: str = "data", accum_steps: int = 1):
     """Assemble a batch-axis-sharded global array from host-local numpy data.
 

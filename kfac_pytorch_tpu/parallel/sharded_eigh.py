@@ -737,9 +737,11 @@ def owner_spectrum_mass(
         check_vma=False,
     )
     def _inner(shard, eigen):
-        dev = lax.axis_index(axes[0])
-        for a in axes[1:]:
-            dev = dev * mesh.shape[a] + lax.axis_index(a)
+        # the shard stacks (and the plan's validity table) are laid out over
+        # the FACTOR axis only — on a 2-D data×tensor mesh every tensor
+        # replica holds the same rows, so the row index is the data-axis
+        # coordinate, not the flat mesh index
+        dev = lax.axis_index(axis_name)
         cap = jnp.float32(0.0)
         tot = jnp.float32(0.0)
         for n, vtab in valid.items():

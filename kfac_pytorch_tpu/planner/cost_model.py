@@ -107,11 +107,13 @@ def model_facts(params, layers=None) -> ModelFacts:
 
     names = list(layers) if layers is not None else capture.layer_names(params)
     gcounts = capture.group_counts(names)
+    scounts = capture.lens_counts(names)
     shapes: Dict[str, Tuple[int, int]] = {}
     diag_a = set()
     has_conv = False
     for name in names:
         base, group_idx = capture.split_group_name(name)
+        base, split_idx = capture.split_lens_name(base)
         node = params
         for k in base.split("/"):
             node = node[k]
@@ -130,6 +132,12 @@ def model_facts(params, layers=None) -> ModelFacts:
             has_conv = True
         else:
             cin, cout = kernel.shape
+            # expand-lens pseudo-layers (fused QKV, "#s" suffix): each
+            # column slice gets its own cout/S-side G factor while the
+            # slices share one a side — the cost model must price the S
+            # small eigendecompositions, not one fused-wide one
+            if split_idx is not None:
+                cout = cout // scounts[base]
             shapes[name] = (int(cout), int(cin + int(has_bias)))
     return ModelFacts(
         shapes=shapes, diag_a=frozenset(diag_a), has_conv=has_conv
@@ -271,8 +279,9 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
             plan, factor_comm_dtype=comm_dtype, factor_comm_freq=comm_freq
         )
 
-    # placement: owner-shard the curvature state at scale
-    if env.world >= OWNER_MIN_WORLD:
+    # placement: owner-shard the curvature state at scale (the shard world
+    # is the data axes only — tensor replicas hold identical rows)
+    if env.factor_world >= OWNER_MIN_WORLD:
         plan = dataclasses.replace(plan, factor_sharding="owner")
 
     # overlap: fuse the factor exchange into the gradient stream whenever
@@ -285,10 +294,12 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
         if plan.factor_comm_freq > 1 or plan.eigh_chunks > 1:
             plan = dataclasses.replace(plan, staleness_budget=1)
 
-    # kernel: pin the fused patch-covariance kernel where it is a fast
-    # path ("auto" already resolves to it on TPU; pinning records the
-    # decision in the plan so the snapshot shows it)
-    if facts.has_conv and env.on_tpu:
+    # kernel: pin the fused capture kernels where they are fast paths —
+    # the conv patch-covariance kernel and the embedding token-gather
+    # kernel both ride the same factor_kernel dispatch ("auto" already
+    # resolves to them on TPU; pinning records the decision in the plan
+    # so the snapshot shows it)
+    if (facts.has_conv or facts.has_diag_a) and env.on_tpu:
         plan = dataclasses.replace(plan, factor_kernel="pallas")
     return plan
 
@@ -305,7 +316,7 @@ def _resolve_memory(facts: ModelFacts, env: PlanEnv) -> Plan:
     sides = _dense_sides(facts)
     max_side = max(sides) if sides else 0
     plan = Plan(
-        factor_sharding="owner" if env.world > 1 else "replicated",
+        factor_sharding="owner" if env.factor_world > 1 else "replicated",
         factor_comm_dtype="bf16" if env.world > 1 else "f32",
     )
     if max_side >= RSVD_SIDE_THRESHOLD:
@@ -352,8 +363,8 @@ def resolve_profile(
                 "owner"
                 if (
                     profile == "memory"
-                    and env.world > 1
-                    or env.world >= OWNER_MIN_WORLD
+                    and env.factor_world > 1
+                    or env.factor_world >= OWNER_MIN_WORLD
                 )
                 else "replicated"
             )
@@ -375,8 +386,10 @@ def _report(facts: ModelFacts, env: PlanEnv, plan: Plan) -> CostReport:
     resolved_cost = refresh_cost(facts, plan)
     bytes_f32, buckets = wire_bytes_f32(facts)
     owner_local = owner_repl = None
-    if plan.factor_sharding == "owner" and env.world > 1:
-        shard = plan_factor_shards(facts.shapes, env.world)
+    if plan.factor_sharding == "owner" and env.factor_world > 1:
+        shard = plan_factor_shards(
+            facts.shapes, env.factor_world, diag_a=set(facts.diag_a)
+        )
         info = shard_plan_bytes(shard, rank_fn=_rank_fn_for(plan))
         owner_local = int(info["total_buffer_local"])
         owner_repl = int(info["replicated_total"])

@@ -185,15 +185,20 @@ class PlanEnv:
     """Everything a plan's validity and cost depend on besides the levers.
 
     ``mesh_axes`` is the KFAC mesh's axis-name tuple (empty when no mesh);
-    ``world`` its total device count (1 without a mesh). The model facts
+    ``world`` its total device count (1 without a mesh); ``data_world`` the
+    device count along the factor (data) axes only — 0 means "same as
+    world", which holds on every 1-D mesh; a 2-D data×tensor mesh passes
+    the data-axis size, since owner shard stacks split over the data axis
+    while tensor replicas hold identical rows. The model facts
     (``has_diag_a_layers``: any embedding/diagonal-A layer captured;
-    ``has_conv_layers``: any conv layer) gate the levers whose refusals
-    fire at ``init(params)`` rather than construction. ``on_tpu`` gates
-    pinning the Pallas factor kernel (elsewhere it only runs in interpret
+    ``has_conv_layers``: any conv layer) feed the cost model's kernel
+    choices — both families have a fused Pallas capture path. ``on_tpu``
+    gates pinning those kernels (elsewhere they only run in interpret
     mode, a test vehicle, not a fast path).
     """
 
     world: int = 1
+    data_world: int = 0  # 0 → world (no tensor axes)
     mesh_axes: Tuple[str, ...] = ()
     precond_method: str = "eigen"
     diag_blocks: int = 1
@@ -210,10 +215,21 @@ class PlanEnv:
         return self.world > 1
 
     @property
+    def factor_world(self) -> int:
+        """Replica count the owner shard plans size to (the data axes)."""
+        return self.data_world or self.world
+
+    @property
     def pure_dp(self) -> bool:
-        """Single-axis (or no) mesh — what the explicit-collective comm
-        wrappers require (training/step.py::require_pure_dp_mesh)."""
-        return len(self.mesh_axes) <= 1
+        """At most one non-tensor mesh axis — what the explicit-collective
+        comm wrappers require (training/step.py::require_pure_dp_mesh).
+        Axes named ``tensor*`` carry replicated compute in the 2-D
+        data×tensor convention (parallel/mesh.py::data_tensor_mesh), so the
+        K-FAC collectives still ride a single data axis through them."""
+        data_axes = [
+            a for a in self.mesh_axes if not str(a).startswith("tensor")
+        ]
+        return len(data_axes) <= 1
 
 
 def _comm_active(plan: Plan) -> bool:
@@ -313,18 +329,14 @@ RULES: Tuple[Rule, ...] = (
         conflicts=lambda p, e: e.multi_device and not e.pure_dp,
         drop=("factor_sharding",),
         enforced_by="constructor",
-        message="factor_sharding='owner' requires a pure data-parallel "
-                "mesh (one axis)",
+        message="factor_sharding='owner' requires a single data axis to "
+                "shard across (extra axes are allowed only under the "
+                "replicated-compute tensor* convention)",
     ),
-    Rule(
-        name="owner_vs_diag_a_layers",
-        applies=lambda p: p.factor_sharding == "owner",
-        conflicts=lambda p, e: e.has_diag_a_layers,
-        drop=("factor_sharding",),
-        enforced_by="init",
-        message="factor_sharding='owner' does not support diagonal-A "
-                "(embedding) layers — no dense A factor to shard",
-    ),
+    # PR-6's owner_vs_diag_a_layers refusal used to live here; owner
+    # sharding now lays diagonal-A (embedding) factors out as [vocab]
+    # vector slots (parallel/assignment.py v-groups), so the composition
+    # is simply valid and has no matrix row.
     Rule(
         name="comm_vs_multi_axis_mesh",
         applies=_comm_active,
@@ -332,8 +344,9 @@ RULES: Tuple[Rule, ...] = (
         drop=("factor_comm_dtype", "factor_comm_freq"),
         enforced_by="train_step",
         message="factor_comm_dtype/factor_comm_freq ride the explicit "
-                "pure-data-parallel collective wrapper (training/step.py "
-                "require_pure_dp_mesh); a multi-axis mesh cannot use them",
+                "single-data-axis collective wrapper (training/step.py "
+                "require_pure_dp_mesh); a mesh with a second non-tensor "
+                "axis cannot use them",
     ),
     Rule(
         name="overlap_vs_multi_axis_mesh",
@@ -342,9 +355,9 @@ RULES: Tuple[Rule, ...] = (
         drop=("comm_overlap",),
         enforced_by="train_step",
         message="comm_overlap=True fuses factor reductions into the "
-                "gradient pmean inside the explicit pure-data-parallel "
-                "wrapper (training/step.py require_pure_dp_mesh); a "
-                "multi-axis mesh cannot use it",
+                "gradient pmean inside the explicit single-data-axis "
+                "wrapper (training/step.py require_pure_dp_mesh); a mesh "
+                "with a second non-tensor axis cannot use it",
     ),
     # Degrade rules: not refusals — the constructor warns and runs with the
     # lever inert — but a RESOLVED plan should not carry dead levers, so

@@ -294,6 +294,19 @@ class KFAC:
                     )
             env = _planner.PlanEnv(
                 world=1 if mesh is None else int(mesh.devices.size),
+                # owner shards split over the data axes only; tensor*
+                # replicas hold identical rows (parallel/mesh.py)
+                data_world=1
+                if mesh is None
+                else int(
+                    np.prod(
+                        [
+                            int(mesh.shape[a])
+                            for a in mesh.axis_names
+                            if not str(a).startswith("tensor")
+                        ]
+                    )
+                ),
                 mesh_axes=()
                 if mesh is None
                 else tuple(str(a) for a in mesh.axis_names),
@@ -448,12 +461,30 @@ class KFAC:
                     "spectra for the diagnostics pytree to read — run "
                     "track_diagnostics with replicated sharding"
                 )
-            if mesh is not None and mesh.devices.size > 1 and len(mesh.axis_names) != 1:
-                raise ValueError(
-                    "factor_sharding='owner' requires a pure data-parallel "
-                    f"mesh (one axis); got axes {tuple(mesh.axis_names)}"
-                )
-            if mesh is None or mesh.devices.size <= 1:
+            if mesh is not None and mesh.devices.size > 1:
+                # The shard stacks ride the factor axis only; extra axes are
+                # fine iff they are replicated-compute tensor axes (the
+                # data_tensor_mesh convention) — anything else would split
+                # examples or factor rows in ways the plan cannot see.
+                bad = [
+                    a
+                    for a in mesh.axis_names
+                    if a != axis_name
+                    and int(mesh.shape[a]) > 1
+                    and not str(a).startswith("tensor")
+                ]
+                if axis_name not in mesh.axis_names or bad:
+                    raise ValueError(
+                        "factor_sharding='owner' requires a data-plane mesh "
+                        f"(axis {axis_name!r} plus optional 'tensor*' axes); "
+                        f"got axes {tuple(mesh.axis_names)}"
+                    )
+            _data_size = (
+                int(mesh.shape[axis_name])
+                if mesh is not None and axis_name in mesh.shape
+                else (mesh.devices.size if mesh is not None else 1)
+            )
+            if mesh is None or _data_size <= 1:
                 # Mirrors the distribute_precondition warning: trainers pass
                 # the same flags to 1-device dev runs. There is nothing to
                 # shard across, so degrade to the (identical-numerics)
@@ -596,8 +627,9 @@ class KFAC:
         is_conv = {}
         for name in names:
             node = params
-            # grouped pseudo-layers ("path#gK") share the base path's params
-            for k in capture.split_group_name(name)[0].split("/"):
+            # grouped ("path#gK") and lensed ("path#sK") pseudo-layers share
+            # the base path's params
+            for k in capture.layer_base(name).split("/"):
                 node = node[k]
             # embedding layers (no "kernel" param) are neither conv nor dense
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
@@ -668,6 +700,17 @@ class KFAC:
             return 1
         return int(self.mesh.devices.size)
 
+    def _data_world(self) -> int:
+        """Replica count along the FACTOR axis — what the owner shard plans
+        size to. On a 2-D data×tensor mesh the shard stacks split over the
+        data axis only (tensor replicas hold identical rows), unlike
+        :meth:`_world`'s all-device eigh work-sharding."""
+        if self.mesh is None:
+            return 1
+        if self.axis_name in self.mesh.shape:
+            return int(self.mesh.shape[self.axis_name])
+        return int(self.mesh.devices.size)
+
     # ------------------------------------------------------------------
     # Owner sharding (factor_sharding="owner")
     # ------------------------------------------------------------------
@@ -676,7 +719,9 @@ class KFAC:
     def owner_sharded(self) -> bool:
         return self.factor_sharding == "owner"
 
-    def _shard_plan(self, shapes: Dict[str, Tuple[int, int]]):
+    def _shard_plan(
+        self, shapes: Dict[str, Tuple[int, int]], diag_a=frozenset()
+    ):
         """The owner-shard layout for this layer-shape set, cached.
 
         The plan is pure host-side configuration (every host derives the
@@ -685,11 +730,17 @@ class KFAC:
         ``shard_plan_bytes`` is the same accounting bench reads, so the two
         cannot drift.
         """
-        key = tuple(sorted((n, tuple(s)) for n, s in shapes.items()))
+        key = (
+            tuple(sorted((n, tuple(s)) for n, s in shapes.items())),
+            tuple(sorted(diag_a)),
+        )
         plan = self._shard_plans.get(key)
         if plan is None:
             plan = plan_factor_shards(
-                shapes, self._world(), self.factor_comm.max_bucket_elems
+                shapes,
+                self._data_world(),
+                self.factor_comm.max_bucket_elems,
+                diag_a=set(diag_a),
             )
             self._shard_plans[key] = plan
             info = shard_plan_bytes(
@@ -733,19 +784,21 @@ class KFAC:
         return out
 
     def _owner_shapes(self, facs: Dict[str, Dict[str, jnp.ndarray]]):
-        """Per-layer gradient-matrix shapes ``{name: (g, a)}`` from full
-        (replicated-form) factors — the key the shard plan is derived from,
-        identical to what ``precondition_assignment`` sees at step time."""
-        shapes = {}
+        """Per-layer gradient-matrix shapes ``{name: (g, a)}`` plus the set
+        of diagonal-A (embedding) layers, from full (replicated-form)
+        factors — the key the shard plan is derived from, identical to what
+        ``precondition_assignment`` sees at step time. Diagonal-A layers
+        shard their [vocab] vector into the plan's ``v<size>`` groups."""
+        shapes, diag = {}, set()
         for name, f in facs.items():
-            if "A" not in f:
-                raise ValueError(
-                    "factor_sharding='owner' does not support diagonal-A "
-                    f"(embedding) layers yet — layer {name!r} has no dense A "
-                    "factor to shard; run embeddings with replicated sharding"
+            if "A_diag" in f:
+                shapes[name] = (
+                    int(f["G"].shape[0]), int(f["A_diag"].shape[0])
                 )
-            shapes[name] = (int(f["G"].shape[0]), int(f["A"].shape[0]))
-        return shapes
+                diag.add(name)
+            else:
+                shapes[name] = (int(f["G"].shape[0]), int(f["A"].shape[0]))
+        return shapes, diag
 
     def _owner_zero_eigen_shard(self, plan) -> Dict[str, Dict[str, jnp.ndarray]]:
         """Zero eigen-shard stacks (the owner analog of _eigen_side_init):
@@ -767,7 +820,26 @@ class KFAC:
                     "d": jnp.zeros((rows, rank), jnp.float32),
                     "rho": jnp.zeros((rows,), jnp.float32),
                 }
+        for n in plan.diag_group_sizes:
+            # diagonal-A vector groups: the eigen entry is just the floored
+            # diagonal — identity eigenvectors need no Q
+            rows = plan.world * plan.diag_group_rows[n]
+            out[f"v{n}"] = {"d": jnp.zeros((rows, n), jnp.float32)}
         return out
+
+    def _owner_diag_eigen(self, shard, plan):
+        """Refreshed eigen entries for the diagonal-A vector groups: the
+        elementwise floor ``d·(d > eps)`` of the current factor shard — the
+        owner twin of the replicated path's dA floor. O(vocab) elementwise on
+        already-sharded stacks, so it runs at EVERY refresh/swap (no
+        chunking, no pending buffer: the pending v entries stay zero and are
+        overwritten here at promotion)."""
+        return {
+            f"v{n}": {
+                "d": shard[f"v{n}"] * (shard[f"v{n}"] > self.eps)
+            }
+            for n in plan.diag_group_sizes
+        }
 
     def _owner_factor_shard_from_full(
         self, facs: Dict[str, Dict[str, jnp.ndarray]], plan
@@ -785,6 +857,14 @@ class KFAC:
                     jax.device_get(facs[s.name][s.factor]), np.float32
                 )
             shard[f"n{n}"] = jnp.asarray(stack)
+        for n in plan.diag_group_sizes:
+            rows = plan.diag_group_rows[n]
+            stack = np.zeros((plan.world * rows, n), np.float32)
+            for s in plan.group_slots(n, diag=True):
+                stack[s.owner * rows + s.row] = np.asarray(
+                    jax.device_get(facs[s.name]["A_diag"]), np.float32
+                )
+            shard[f"v{n}"] = jnp.asarray(stack)
         return shard
 
     def owner_state_from_replicated(self, state: KFACState) -> KFACState:
@@ -804,16 +884,21 @@ class KFAC:
                 "'owner'"
             )
         facs = state["factors"]
-        shapes = self._owner_shapes(facs)
-        plan = self._shard_plan(shapes)
+        shapes, diag_a = self._owner_shapes(facs)
+        plan = self._shard_plan(shapes, frozenset(diag_a))
         full_eigen = self._eigen_entries_from_split(
-            state["eigen"], state.get("eigen_stacked") or {}, shapes
+            state["eigen"],
+            state.get("eigen_stacked") or {},
+            {n: s for n, s in shapes.items() if n not in diag_a},
         )
         eigen_shard = self._owner_eigen_shard_from_full(full_eigen, plan)
         new_state = {
             "step": state["step"],
+            # placeholders keep the A_diag key for diagonal-A layers so the
+            # step-time plan can re-derive the diag set from state alone
             "factors": {
-                name: {"A": jnp.zeros((), jnp.float32),
+                name: {("A_diag" if name in diag_a else "A"):
+                       jnp.zeros((), jnp.float32),
                        "G": jnp.zeros((), jnp.float32)}
                 for name in facs
             },
@@ -839,7 +924,10 @@ class KFAC:
         if self.factor_comm.defer:
             new_state["factor_local"] = {
                 name: {
-                    "A": jnp.zeros((shapes[name][1],) * 2, jnp.float32),
+                    "A": jnp.zeros(
+                        (shapes[name][1],) * (1 if name in diag_a else 2),
+                        jnp.float32,
+                    ),
                     "G": jnp.zeros((shapes[name][0],) * 2, jnp.float32),
                 }
                 for name in facs
@@ -882,10 +970,14 @@ class KFAC:
             # np.array (not asarray): device_get returns read-only views
             host = {k: np.array(jax.device_get(v)) for k, v in grp.items()}
             n = int(key[1:])
-            rows = plan.group_rows[n]
-            for s in plan.group_slots(n):
+            diag = key.startswith("v")
+            rows = (plan.diag_group_rows if diag else plan.group_rows)[n]
+            for s in plan.group_slots(n, diag):
                 e = eigen[s.name]
                 row = s.owner * rows + s.row
+                if diag:
+                    host["d"][row] = np.asarray(jax.device_get(e["dA"]))
+                    continue
                 host["Q"][row] = np.asarray(
                     jax.device_get(e[f"Q{s.factor}"])
                 )
@@ -931,9 +1023,11 @@ class KFAC:
         """
         names, _ = self._layer_meta(params)
         gcounts = capture.group_counts(names)
+        scounts = capture.lens_counts(names)
         facs, eigen = {}, {}
         for name in names:
             base, group_idx = capture.split_group_name(name)
+            base, split_idx = capture.split_lens_name(base)
             node = params
             for k in base.split("/"):
                 node = node[k]
@@ -971,6 +1065,12 @@ class KFAC:
                 g_side = cout
             else:
                 cin, cout = kernel.shape
+                if split_idx is not None:
+                    # fused-projection lens pseudo-layer ("path#sK"): the
+                    # shared input keeps the full A side; the O axis splits
+                    # across the S column slices (expand setting,
+                    # arxiv 2311.00636)
+                    cout = cout // scounts[base]
                 a_side = cin + int(has_bias)
                 g_side = cout
             facs[name] = {
@@ -1067,13 +1167,16 @@ class KFAC:
         and ``eigh_chunks > 1`` adds the sharded pending double buffer.
         Returned already placed per :meth:`state_shardings`.
         """
-        shapes = self._owner_shapes(facs)
-        plan = self._shard_plan(shapes)
+        shapes, diag_a = self._owner_shapes(facs)
+        plan = self._shard_plan(shapes, frozenset(diag_a))
         eigen_shard = self._owner_zero_eigen_shard(plan)
         state = {
             "step": jnp.zeros((), jnp.int32),
+            # diagonal-A layers keep their A_diag placeholder KEY so the
+            # step-time plan re-derives the diag set from state alone
             "factors": {
-                name: {"A": jnp.zeros((), jnp.float32),
+                name: {("A_diag" if name in diag_a else "A"):
+                       jnp.zeros((), jnp.float32),
                        "G": jnp.zeros((), jnp.float32)}
                 for name in facs
             },
@@ -1095,7 +1198,10 @@ class KFAC:
             # its own full-size per-replica buffer, zeroed at every flush.
             state["factor_local"] = {
                 name: {
-                    "A": jnp.zeros((shapes[name][1],) * 2, jnp.float32),
+                    "A": jnp.zeros(
+                        (shapes[name][1],) * (1 if name in diag_a else 2),
+                        jnp.float32,
+                    ),
                     "G": jnp.zeros((shapes[name][0],) * 2, jnp.float32),
                 }
                 for name in facs
@@ -1238,8 +1344,9 @@ class KFAC:
         is_conv = {}
         for name in names:
             node = grads
-            # grouped pseudo-layers ("path#gK") share the base path's grads
-            for k in capture.split_group_name(name)[0].split("/"):
+            # grouped ("path#gK") and lensed ("path#sK") pseudo-layers share
+            # the base path's grads
+            for k in capture.layer_base(name).split("/"):
                 node = node[k]
             is_conv[name] = "kernel" in node and node["kernel"].ndim == 4
 
@@ -1621,7 +1728,12 @@ class KFAC:
             name: (int(g.shape[0]), int(g.shape[1]))
             for name, g in gmats.items()
         }
-        plan = self._shard_plan(shapes)
+        # the diag set travels in the state placeholders' key names, so the
+        # step-time plan matches init()'s exactly
+        diag_a = frozenset(
+            n for n in names if "A_diag" in state["factors"][n]
+        )
+        plan = self._shard_plan(shapes, diag_a)
         alpha = self.factor_decay
 
         shard = state["factor_shard"]
@@ -1698,15 +1810,18 @@ class KFAC:
                 )
         if update_eigen:
             with tel.span("trace/kfac/eigh"):
-                eigen_shard = owner_eigen_update(
-                    shard,
-                    plan,
-                    self.mesh,
-                    self.axis_name,
-                    self.eps,
-                    rank_fn=self._rank_fn(),
-                    eigen_dtype=self.eigen_dtype,
-                )
+                eigen_shard = {
+                    **owner_eigen_update(
+                        shard,
+                        plan,
+                        self.mesh,
+                        self.axis_name,
+                        self.eps,
+                        rank_fn=self._rank_fn(),
+                        eigen_dtype=self.eigen_dtype,
+                    ),
+                    **self._owner_diag_eigen(shard, plan),
+                }
                 if self.solver == "rsvd":
                     spectrum_mass = owner_spectrum_mass(
                         shard,
@@ -1736,7 +1851,9 @@ class KFAC:
                     eigen_dtype=self.eigen_dtype,
                 )
             if swap_eigen:
-                eigen_shard = pending
+                eigen_shard = {
+                    **pending, **self._owner_diag_eigen(shard, plan)
+                }
                 if self.solver == "rsvd":
                     spectrum_mass = owner_spectrum_mass(
                         shard,
@@ -1749,7 +1866,9 @@ class KFAC:
         elif swap_eigen:
             # Bare-swap catch-up (bounded staleness), owner form: promote
             # the fully-landed pending shard without running any chunk.
-            eigen_shard = pending
+            eigen_shard = {
+                **pending, **self._owner_diag_eigen(shard, plan)
+            }
             if self.solver == "rsvd":
                 spectrum_mass = owner_spectrum_mass(
                     shard,
@@ -1816,6 +1935,7 @@ class KFAC:
             plan=plan,
             rank_fn=self._rank_fn(),
             eigen_dtype=self.eigen_dtype,
+            axis_name=self.axis_name,
         )
         nu = precond_ops.kl_clip_coefficient(
             updates, gmats, lr, self.hparams.kl_clip

@@ -115,8 +115,21 @@ def make_lm_train_step(
             if kfac.layers is not None
             else capture.layer_names_from_capture(mut[KFAC_ACTS])
         )
-        a_c = capture.a_contribs(mut[KFAC_ACTS], names)
-        g_s = capture.g_factors(gperts, names, batch_averaged=kfac.batch_averaged)
+        # cross-args thread the tied-weight (reduce-lens) statistics: the
+        # decoder-site contributions live on the perturbation-grad side for A
+        # and the captured side for G (capture.py, arxiv 2311.00636)
+        a_c = capture.a_contribs(
+            mut[KFAC_ACTS],
+            names,
+            perturb_grads=gperts,
+            batch_averaged=kfac.batch_averaged,
+        )
+        g_s = capture.g_factors(
+            gperts,
+            names,
+            batch_averaged=kfac.batch_averaged,
+            captured=mut[KFAC_ACTS],
+        )
         return loss, grads, a_c, g_s, new_carry
 
     def _compute_compressed(params, tokens, targets, carry, dropout_rng,

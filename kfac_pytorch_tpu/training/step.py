@@ -35,13 +35,24 @@ PyTree = Any
 
 def require_pure_dp_mesh(mesh) -> str:
     """The compressed-grad wrappers need every device to see whole examples:
-    returns the batch axis name, rejecting meshes with a real second axis."""
-    if any(mesh.shape[a] > 1 for a in mesh.axis_names[1:]):
+    returns the batch axis name, rejecting meshes with a real second axis.
+
+    Axes named ``tensor*`` are exempt (parallel/mesh.py::data_tensor_mesh):
+    by convention they are replicated-compute — parameters and batch carry
+    ``P()`` over them, so every tensor replica still sees whole examples and
+    all K-FAC/grad collectives stay confined to the data axis.
+    """
+    bad = [
+        a
+        for a in mesh.axis_names[1:]
+        if mesh.shape[a] > 1 and not str(a).startswith("tensor")
+    ]
+    if bad:
         raise ValueError(
-            "grad_comm_dtype requires a pure data-parallel mesh (non-data "
-            f"axes of size 1); got {dict(mesh.shape)} — a sequence/model "
-            "axis would make the per-device local forward see a partial "
-            "example"
+            "grad_comm_dtype requires a data-plane mesh (non-data axes of "
+            f"size 1 or named 'tensor*'); got {dict(mesh.shape)} — a "
+            "sequence/model axis would make the per-device local forward "
+            "see a partial example"
         )
     return mesh.axis_names[0]
 
@@ -318,9 +329,15 @@ def make_train_step(
             names = kfac.layers
         else:
             names = capture.layer_names_from_capture(mut[KFAC_ACTS])
-        a_c = capture.a_contribs(mut[KFAC_ACTS], names)
+        ba = kfac.batch_averaged if kfac else True
+        # cross-args thread the tied-weight (reduce-lens) statistics: the
+        # decoder-site contributions live on the perturbation-grad side for A
+        # and the captured side for G (capture.py, arxiv 2311.00636)
+        a_c = capture.a_contribs(
+            mut[KFAC_ACTS], names, perturb_grads=gperts, batch_averaged=ba
+        )
         g_s = capture.g_factors(
-            gperts, names, batch_averaged=kfac.batch_averaged if kfac else True
+            gperts, names, batch_averaged=ba, captured=mut[KFAC_ACTS]
         )
         new_bs = mut.get("batch_stats", batch_stats)
         acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -19,6 +19,23 @@ replicated baseline must contain neither op (its factor exchange is the
 bucketed all-reduce pinned above), so a regression that sneaks extra
 gathers/scatters into either mode fails loudly.
 
+Third section: the 2-D data×tensor mesh. K-FAC's collectives must ride the
+``data`` axis only — under the replicated-compute ``tensor*`` convention the
+tensor axis holds identical copies, and a factor collective spanning the
+whole mesh would both waste wire and silently average statistics that are
+already equal. The pin compiles the owner-sharded capture step for an
+embedding+dense LM head on a 4×2 ``data_tensor_mesh`` and asserts (a) the
+same rs/ag budget as the 1-D owner pin (≤ planned buckets, exactly one
+all-gather — "allgather count unchanged"), and (b) every factor collective's
+``replica_groups`` has groups of exactly the DATA world (4), never the full
+mesh (8).
+
+Fourth section: compile-only memory regression for the embedding capture.
+The token-gather kernel's compiled temp bytes (XLA ``memory_analysis``, via
+bench.py's ``_compiled_memory``) must stay under a tenth of the dense
+one-hot oracle's — the [B·T, V] one-hot and dense [V, V] A factor must
+never materialize.
+
 Exit 0 with an "OK" line, 1 with a report. Run from the repo root
 (tier-1 wraps it in a test, tests/test_scripts.py).
 """
@@ -57,6 +74,22 @@ from kfac_pytorch_tpu.training.step import (  # noqa: E402
 _ALLREDUCE_RE = re.compile(r"all-reduce(?:-start)?\(")
 _REDUCE_SCATTER_RE = re.compile(r"reduce-scatter(?:-start)?\(")
 _ALLGATHER_RE = re.compile(r"all-gather(?:-start)?\(")
+# replica_groups in both HLO spellings: literal {{0,2},{1,3}} and iota
+# [num_groups,group_size]<=[...] (the V2 form XLA emits for regular grids)
+_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{\{([0-9,{} ]*)\}\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def _group_sizes(line: str) -> list:
+    """Replica-group sizes of one collective instruction line (empty when the
+    instruction carries no group list — XLA then means 'all devices')."""
+    m = _GROUPS_LITERAL_RE.search(line)
+    if m:
+        return [len(g.split(",")) for g in m.group(1).split("},{") if g]
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return [int(m.group(2))] * int(m.group(1))
+    return []
 
 
 class _Net(nn.Module):
@@ -160,6 +193,161 @@ def _check_owner(mesh, model, x, y) -> int:
     return 0
 
 
+class _LMHead(nn.Module):
+    """Embedding + dense head: one diagonal-A layer and one matrix layer, so
+    the 2-D pin covers both the v-group scatter and the matrix buckets."""
+
+    @nn.compact
+    def __call__(self, ids, train=True):
+        from kfac_pytorch_tpu.models.layers import KFACEmbed
+
+        x = KFACEmbed(32, 16, name="emb")(ids)
+        x = jnp.mean(x, axis=1)
+        return KFACDense(10, name="fc")(x)
+
+
+def _check_2d_mesh() -> int:
+    """data×tensor pin: owner-sharded K-FAC on a 4×2 mesh keeps the 1-D
+    collective budget AND every factor collective stays inside a data-axis
+    replica group (size 4), never spanning the full 8-device mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kfac_pytorch_tpu.parallel.mesh import data_tensor_mesh
+
+    mesh = data_tensor_mesh(2)
+    data_world = mesh.shape["data"]
+    model = _LMHead()
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, 32, size=(16, 12)).astype(np.int32))
+    y = jnp.asarray(r.randint(0, 10, size=16))
+    tx = make_sgd(momentum=0.9)
+    lr, damping = jnp.float32(0.1), jnp.float32(0.01)
+
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                mesh=mesh, factor_sharding="owner",
+                factor_comm_dtype="bf16", factor_comm_freq=1)
+    params = model.init(jax.random.PRNGKey(0), ids, train=True)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    kstate = jax.device_put(
+        state.kfac_state, kfac.state_shardings(state.kfac_state)
+    )
+    state = state.replace(kfac_state=None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    state = state.replace(kfac_state=kstate)
+    batch = tuple(
+        jax.device_put(b, NamedSharding(mesh, P("data"))) for b in (ids, y)
+    )
+    step_fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True},
+        mesh=mesh, grad_comm_dtype=jnp.float32,
+    )
+    hlo = step_fn.lower(
+        state, batch, lr, damping, update_factors=True, update_eigen=False
+    ).compile().as_text()
+
+    rs_lines = [ln for ln in hlo.splitlines() if _REDUCE_SCATTER_RE.search(ln)]
+    ag_lines = [ln for ln in hlo.splitlines() if _ALLGATHER_RE.search(ln)]
+    buckets = kfac.factor_comm.last_collectives or 0
+    print(
+        f"check_collective_count: 2-D mesh ({mesh.shape}) owner capture step "
+        f"{len(rs_lines)} reduce-scatter(s) vs {buckets} planned bucket(s), "
+        f"{len(ag_lines)} all-gather(s)"
+    )
+    if buckets < 1:
+        print("check_collective_count: FAIL — 2-D owner capture trace never "
+              "planned scatter buckets", file=sys.stderr)
+        return 1
+    if len(rs_lines) > buckets:
+        print(
+            f"check_collective_count: FAIL — 2-D mesh capture step has "
+            f"{len(rs_lines)} reduce-scatters vs {buckets} planned bucket(s); "
+            "the scatter-merge has unfused under the tensor axis",
+            file=sys.stderr,
+        )
+        return 1
+    if len(ag_lines) != 1:
+        print(
+            f"check_collective_count: FAIL — 2-D mesh capture step has "
+            f"{len(ag_lines)} all-gathers; the owner contract (exactly ONE "
+            "preconditioned-gradient exchange) must not change with the "
+            "tensor axis", file=sys.stderr,
+        )
+        return 1
+    for ln in rs_lines + ag_lines:
+        sizes = _group_sizes(ln)
+        if not sizes:
+            print(
+                "check_collective_count: FAIL — 2-D mesh factor collective "
+                "carries no replica_groups (spans the whole mesh):\n  "
+                + ln.strip()[:200], file=sys.stderr,
+            )
+            return 1
+        if any(s != data_world for s in sizes):
+            print(
+                f"check_collective_count: FAIL — 2-D mesh factor collective "
+                f"replica groups {sizes} != data world {data_world}; a "
+                "factor collective escaped the data axis:\n  "
+                + ln.strip()[:200], file=sys.stderr,
+            )
+            return 1
+    print(
+        "check_collective_count: OK — 2-D mesh factor collectives confined "
+        f"to data-axis groups of {data_world}, all-gather count unchanged"
+    )
+    return 0
+
+
+def _check_embed_memory() -> int:
+    """Compile-only memory pin: the token-gather embedding capture must not
+    materialize the one-hot program — temp bytes < dense oracle / 10."""
+    from bench import _compiled_memory
+
+    from kfac_pytorch_tpu.ops import factor_kernels, factors
+
+    vocab, toks = 4096, (16, 512)  # one-hot temp: 16·512·4096·4 B = 128 MiB
+    ids = jnp.zeros(toks, jnp.int32)
+    fused = _compiled_memory(
+        jax.jit(lambda i: factor_kernels.compute_a_embed_fused(i, vocab))
+        .lower(ids)
+    )
+    dense = _compiled_memory(
+        jax.jit(lambda i: factors.compute_a_embed_onehot(i, vocab)).lower(ids)
+    )
+    if "temp_bytes" not in fused or "temp_bytes" not in dense:
+        # memory_analysis is best-effort per backend; absence is a skip, not
+        # a regression (the TPU path reports it)
+        print(
+            "check_collective_count: OK — embedding memory pin skipped "
+            f"(memory_analysis unavailable: {fused.get('error') or dense.get('error')})"
+        )
+        return 0
+    print(
+        f"check_collective_count: embedding capture temp bytes "
+        f"{fused['temp_bytes']} (token-gather) vs {dense['temp_bytes']} "
+        "(dense one-hot oracle)"
+    )
+    if fused["temp_bytes"] * 10 >= dense["temp_bytes"]:
+        print(
+            "check_collective_count: FAIL — the token-gather capture's temp "
+            f"bytes ({fused['temp_bytes']}) are not under a tenth of the "
+            f"dense one-hot oracle's ({dense['temp_bytes']}); the [B·T, V] "
+            "one-hot is materializing again", file=sys.stderr,
+        )
+        return 1
+    print(
+        "check_collective_count: OK — embedding capture stays "
+        f"{dense['temp_bytes'] // max(fused['temp_bytes'], 1)}× under the "
+        "one-hot footprint"
+    )
+    return 0
+
+
 def main() -> int:
     mesh = data_parallel_mesh()
     model = _Net()
@@ -211,7 +399,13 @@ def main() -> int:
         return 1
     print(f"check_collective_count: OK — factor exchange fused into "
           f"≤ {buckets} bucketed all-reduce(s)")
-    return _check_owner(mesh, model, x, y)
+    rc = _check_owner(mesh, model, x, y)
+    if rc:
+        return rc
+    rc = _check_2d_mesh()
+    if rc:
+        return rc
+    return _check_embed_memory()
 
 
 if __name__ == "__main__":

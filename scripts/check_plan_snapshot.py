@@ -99,9 +99,10 @@ _RESNET50 = {
 # --- fixture 3: transformer LM (vocab 32768, d_model 512, 4 blocks,
 # kfac_embedding) on a v5e-8 pure-DP mesh (examples/train_transformer_
 # lm.py's model at production size, shapes = planner.model_facts with
-# capture.discover_layers). The diag-A embedding must force the owner
-# lever OFF via the validity matrix (owner_vs_diag_a_layers), visible in
-# "dropped".
+# capture.discover_layers). The diag-A embedding now COMPOSES with owner
+# sharding (its [vocab] diagonal lays out as v-group vector slots,
+# parallel/assignment.py) — the snapshot pins owner staying ON with the
+# embedding in the shard report, where PR-6's matrix refused it.
 _TRANSFORMER_LM = {
     **{
         f"block_{i}/{lay}": shape
@@ -139,6 +140,19 @@ FIXTURES = {
         world=8,
         mesh_axes=("data",),
     ),
+    # fixture 4: the same LM on a v5e-16 2-D data×tensor mesh (8 data × 2
+    # tensor, parallel/mesh.py::data_tensor_mesh). The tensor axis carries
+    # replicated compute, so the planner must treat the mesh as pure-DP
+    # (no comm/owner/overlap drops) while sizing owner shards to the DATA
+    # world (8), not the 16-device total.
+    "transformer_lm_x8x2": dict(
+        shapes=_TRANSFORMER_LM,
+        diag_a=("tok_embed",),
+        has_conv=False,
+        world=16,
+        data_world=8,
+        mesh_axes=("data", "tensor"),
+    ),
 }
 
 
@@ -153,6 +167,7 @@ def resolve_fixture(name: str) -> dict:
     )
     env = PlanEnv(
         world=fx["world"],
+        data_world=fx.get("data_world", 0),
         mesh_axes=tuple(fx["mesh_axes"]),
         on_tpu=True,
         has_diag_a_layers=facts.has_diag_a,

@@ -219,6 +219,89 @@ def test_train_step_pallas_matches_dense_end_to_end():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+# (batch, seqlen, vocab) — chosen to hit every padding corner of the
+# token-gather kernel: n·t not a multiple of the 1024 token block, vocab
+# not a multiple of the 512 tile, single blocks, and the aligned case.
+EMBED_PARITY_CASES = [
+    (6, 7, 11),        # tiny: one token block, one vocab tile
+    (3, 700, 37),      # n=2100 spans 3 token blocks, ragged tail
+    (4, 50, 777),      # vocab spans 2 tiles with a ragged tail
+    (2, 1100, 1030),   # both axes ragged at once
+    (2, 512, 512),     # exactly block/tile aligned
+]
+
+
+@pytest.mark.parametrize("batch,seqlen,vocab", EMBED_PARITY_CASES)
+def test_embed_fused_matches_scatter_oracle_bitwise(batch, seqlen, vocab):
+    """Token-gather kernel vs the scatter-add oracle. Both accumulate
+    integer counts in f32 and divide once by N, so parity is BITWISE —
+    any drift means the sentinel/padding plan leaked counts."""
+    r = np.random.RandomState(batch * 1000 + vocab)
+    ids = jnp.asarray(r.randint(0, vocab, size=(batch, seqlen)).astype(np.int32))
+    want = factors.compute_a_embed(ids, vocab)
+    got = factor_kernels.compute_a_embed_fused(ids, vocab, interpret=True)
+    assert got.shape == want.shape == (vocab,) and got.dtype == want.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # ...and both agree with the dense one-hot diagonal it stands in for
+    dense = factors.compute_a_embed_onehot(ids, vocab)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_embed_fused_under_jit():
+    """Jitted, int ids (no tangent — the dispatcher never wraps these in
+    stop_gradient), 1-D ids accepted like the oracle."""
+    r = np.random.RandomState(21)
+    ids = jnp.asarray(r.randint(0, 91, size=(130,)).astype(np.int32))
+    got = jax.jit(
+        lambda i: factor_kernels.compute_a_embed_fused(i, 91, interpret=True)
+    )(ids)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(factors.compute_a_embed(ids, 91)))
+
+
+def test_embed_dispatch_routes_and_records_gauge():
+    tel = tel_mod.configure(enabled=True)
+    try:
+        r = np.random.RandomState(23)
+        ids = jnp.asarray(r.randint(0, 33, size=(4, 9)).astype(np.int32))
+        want = factors.compute_a_embed(ids, 33)
+        with factor_kernels.factor_kernel_scope("pallas"):
+            got = factor_kernels.dispatch_compute_a_embed(ids, 33)
+        assert tel.snapshot()["gauges"]["kfac/embedding_capture_kernel"] == 1.0
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        got_d = factor_kernels.dispatch_compute_a_embed(ids, 33)
+        assert tel.snapshot()["gauges"]["kfac/embedding_capture_kernel"] == 0.0
+        np.testing.assert_array_equal(np.asarray(got_d), np.asarray(want))
+    finally:
+        tel_mod.configure(enabled=False)
+        tel.reset()
+
+
+def test_embed_fused_compiled_memory_beats_one_hot():
+    """Compile-only: the [B·T, V] one-hot (and the [V, V] dense A it feeds)
+    must never exist on the fused path. 16×512 tokens over a 4096 vocab put
+    the one-hot temporary at 128 MB; the kernel streams token blocks."""
+    vocab, toks = 4096, (16, 512)
+    ids = jax.ShapeDtypeStruct(toks, jnp.int32)
+    fused = jax.jit(
+        lambda i: factor_kernels.compute_a_embed_fused(i, vocab, interpret=True)
+    )
+    dense = jax.jit(lambda i: factors.compute_a_embed_onehot(i, vocab))
+    m_fused = fused.lower(ids).compile().memory_analysis()
+    m_dense = dense.lower(ids).compile().memory_analysis()
+    if m_fused is None or m_dense is None:
+        pytest.skip("backend does not report compiled memory stats")
+    one_hot_bytes = toks[0] * toks[1] * vocab * 4
+    assert m_dense.temp_size_in_bytes >= one_hot_bytes, (
+        "one-hot oracle no longer materializes [B·T, V] — update this test"
+    )
+    assert m_fused.temp_size_in_bytes * 10 < m_dense.temp_size_in_bytes, (
+        f"fused temp {m_fused.temp_size_in_bytes} not 10x below dense "
+        f"{m_dense.temp_size_in_bytes}"
+    )
+
+
 def test_fused_compiled_memory_beats_dense_im2col():
     """ResNet-50 stage-1 geometry at the batch-128 lever: [128,56,56,64] 3x3
     SAME. Compile-only (memory_analysis never executes), so the dense arm's

@@ -36,7 +36,7 @@ from kfac_pytorch_tpu.parallel.assignment import (
     plan_factor_shards,
     shard_plan_bytes,
 )
-from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, data_tensor_mesh
 from kfac_pytorch_tpu.training.step import (
     TrainState,
     kfac_flags_for_step,
@@ -92,11 +92,12 @@ def _put(state, batch, mesh, kfac):
     return state, tuple(jax.device_put(b, bshard) for b in batch)
 
 
-def _run(kw_extra, steps=7):
+def _run(kw_extra, steps=7, mesh=None):
     """steps=7 at kfac_update_freq=3 crosses two refresh boundaries (steps
     3 and 6), so parity covers capture, refresh, and post-refresh
     preconditioning in both EMA regimes."""
-    mesh = data_parallel_mesh()
+    if mesh is None:
+        mesh = data_parallel_mesh()
     kw = dict(damping=0.01, fac_update_freq=1, kfac_update_freq=3, mesh=mesh)
     kw.update(kw_extra)
     kfac = KFAC(**kw)
@@ -136,6 +137,31 @@ def test_owner_matches_replicated(extra):
     s_rep, _ = _run(dict(extra))
     s_own, _ = _run({**extra, "factor_sharding": "owner"})
     _assert_close(s_rep.params, s_own.params)
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        pytest.param({}, id="base"),
+        pytest.param({"eigh_chunks": 2}, id="eigh_chunks"),
+        pytest.param({"factor_comm_freq": 2}, id="comm_freq"),
+        pytest.param(
+            {"solver": "rsvd", "solver_auto_threshold": 16, "solver_rank": 8},
+            id="rsvd",
+        ),
+        pytest.param({"factor_sharding": "owner"}, id="owner"),
+    ],
+)
+def test_2d_mesh_matches_1d_mesh(extra):
+    """Lifting the pure-DP guard: on a 4×2 data×tensor mesh (the tensor
+    axis carries replicated compute) every K-FAC lever must land the same
+    parameters as the plain 8-device DP mesh — the global batch statistics
+    are identical, only the collective replica groups change (owner shards
+    size to factor_world=4 instead of 8, the EMA is linear, so parity
+    holds up to reassociation)."""
+    s_1d, _ = _run(dict(extra))
+    s_2d, _ = _run(dict(extra), mesh=data_tensor_mesh(2))
+    _assert_close(s_1d.params, s_2d.params)
 
 
 # --------------------------------------------------------------- memory
@@ -257,12 +283,19 @@ def test_owner_refuses_unsupported_compositions(kw, msg):
 
 
 def test_owner_refuses_multi_axis_mesh():
+    """A real second axis (sequence/model parallel) still refuses — only
+    replicated-compute 'tensor*' axes ride along (data_tensor_mesh)."""
     from jax.sharding import Mesh
 
     devices = np.asarray(jax.devices()).reshape(4, 2)
     mesh = Mesh(devices, ("data", "seq"))
-    with pytest.raises(ValueError, match="one axis"):
+    with pytest.raises(ValueError, match="data-plane"):
         KFAC(damping=0.01, mesh=mesh, factor_sharding="owner")
+    # the exempt spelling constructs and owner-shards over the data axis
+    assert KFAC(
+        damping=0.01, mesh=Mesh(devices, ("data", "tensor")),
+        factor_sharding="owner",
+    ).owner_sharded
 
 
 def test_owner_degrades_on_single_device(capsys):
@@ -275,10 +308,26 @@ def test_owner_degrades_on_single_device(capsys):
     assert "WARNING" in capsys.readouterr().out
 
 
-def test_owner_refuses_embedding_layers():
-    """Diagonal-A (embedding) factors have no dense matrix to shard; init
-    must refuse rather than build a broken plan."""
+def test_owner_shapes_diag_a_layers():
+    """Diagonal-A (embedding) factors shard as [vocab] vector slots: the
+    shape map reports (features, vocab) and the layer lands in the diag set
+    (the PR-6 refusal replaced by the real v-group rule)."""
     mesh = data_parallel_mesh()
     kfac = KFAC(damping=0.01, mesh=mesh, factor_sharding="owner")
-    with pytest.raises(ValueError, match="embedding"):
-        kfac._owner_shapes({"emb": {"G": jnp.zeros((4, 4))}})
+    shapes, diag = kfac._owner_shapes(
+        {
+            "emb": {
+                "A_diag": jnp.ones((32,)),
+                "G": jnp.zeros((4, 4)),
+            },
+            "dense": {"A": jnp.eye(5), "G": jnp.zeros((4, 4))},
+        }
+    )
+    assert shapes == {"emb": (4, 32), "dense": (4, 5)}
+    assert diag == {"emb"}
+    plan = kfac._shard_plan(shapes, frozenset(diag))
+    assert plan.diag_group_sizes == (32,)
+    slot = plan.slot("emb", "A")
+    assert slot.diag and slot.size == 32
+    assert not plan.slot("emb", "G").diag
+    assert not plan.slot("dense", "A").diag

@@ -1,0 +1,314 @@
+"""Expand/reduce Kronecker lens tests (capture.py, models/layers.py).
+
+The oracle for the expand lens (fused QKV): a KFACDense with
+``lens_splits=S`` must behave EXACTLY like S independent narrow layers
+sharing one input — same A factor, per-column-slice G factors computed
+with the same ops, and bitwise-identical preconditioned updates after
+write_back reassembles the fused kernel (*KFAC for Modern Neural Network
+Architectures*, arxiv 2311.00636, "expand" setting).
+
+The oracle for the reduce lens (tied embedding/output head): the shared
+table is ONE preconditioned layer whose factors accumulate both use
+sites once — token-frequency diagonal + decoder logit-grad diagonal on
+the A side, embed-site output covariance + decoder query covariance on
+the G side ("reduce" setting).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC, capture
+from kfac_pytorch_tpu.models.layers import (
+    A_SPLIT,
+    KFAC_ACTS,
+    KFACDense,
+    KFACEmbed,
+    OUT_PERTURB,
+    OUT_TIED,
+    PERTURBATIONS,
+)
+from kfac_pytorch_tpu.ops import factors as F
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+CIN, M, S, B = 6, 16, 3, 24
+
+
+def _fused_setup(seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(B, CIN).astype(np.float32))
+    gout = jnp.asarray(r.randn(B, S * M).astype(np.float32) / B)
+    w = jnp.asarray(r.randn(CIN, S * M).astype(np.float32))
+    b = jnp.asarray(r.randn(S * M).astype(np.float32))
+    wg = jnp.asarray(r.randn(CIN, S * M).astype(np.float32))
+    bg = jnp.asarray(r.randn(S * M).astype(np.float32))
+    return x, gout, w, b, wg, bg
+
+
+def test_capture_expand_lens_matches_unfused_bitwise():
+    """a_contribs/g_factors/layer_grads on the sown [S, a, a] stack must
+    equal the unfused per-layer computations bitwise — the slices run the
+    exact same ops on the exact same values."""
+    x, gout, _, _, wg, bg = _fused_setup()
+    a_full = F.compute_a_dense(x, has_bias=True)
+    names = [f"qkv{capture.SPLIT_SEP}{i}" for i in range(S)]
+    captured = {"qkv": {A_SPLIT: jnp.broadcast_to(a_full[None], (S,) + a_full.shape)}}
+    perturb = {"qkv": {OUT_PERTURB: gout}}
+    grads = {"qkv": {"kernel": wg, "bias": bg}}
+
+    a_c = capture.a_contribs(captured, names)
+    g_s = capture.g_factors(perturb, names, batch_averaged=True)
+    lg = capture.layer_grads(grads, names)
+    for i, name in enumerate(names):
+        np.testing.assert_array_equal(np.asarray(a_c[name]), np.asarray(a_full))
+        want_g = F.compute_g_dense(gout[:, i * M:(i + 1) * M], batch_averaged=True)
+        np.testing.assert_array_equal(np.asarray(g_s[name]), np.asarray(want_g))
+        np.testing.assert_array_equal(
+            np.asarray(lg[name]["kernel"]), np.asarray(wg[:, i * M:(i + 1) * M]))
+        np.testing.assert_array_equal(
+            np.asarray(lg[name]["bias"]), np.asarray(bg[i * M:(i + 1) * M]))
+
+
+@pytest.mark.parametrize("method", ["eigen", "inverse"])
+def test_update_expand_lens_matches_unfused_bitwise(method):
+    """KFAC.update over the S pseudo-layers vs over S real narrow layers:
+    the reassembled fused kernel/bias update must match the unfused
+    per-layer updates BITWISE — the lens changes bookkeeping, not math."""
+    x, gout, w, b, wg, bg = _fused_setup(seed=1)
+    a_full = F.compute_a_dense(x, has_bias=True)
+
+    fused_names = [f"qkv{capture.SPLIT_SEP}{i}" for i in range(S)]
+    fused_params = {"qkv": {"kernel": w, "bias": b}}
+    fused_grads = {"qkv": {"kernel": wg, "bias": bg}}
+    kf = KFAC(damping=0.01, precond_method=method, layers=fused_names)
+    gf, _ = kf.update(
+        fused_grads, kf.init(fused_params),
+        a_contribs={n: a_full for n in fused_names},
+        g_factor_stats={
+            n: F.compute_g_dense(gout[:, i * M:(i + 1) * M], batch_averaged=True)
+            for i, n in enumerate(fused_names)
+        },
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+
+    split_names = ["q", "k", "v"]
+    split_params = {
+        n: {"kernel": w[:, i * M:(i + 1) * M], "bias": b[i * M:(i + 1) * M]}
+        for i, n in enumerate(split_names)
+    }
+    split_grads = {
+        n: {"kernel": wg[:, i * M:(i + 1) * M], "bias": bg[i * M:(i + 1) * M]}
+        for i, n in enumerate(split_names)
+    }
+    ks = KFAC(damping=0.01, precond_method=method, layers=split_names)
+    gs, _ = ks.update(
+        split_grads, ks.init(split_params),
+        a_contribs={n: a_full for n in split_names},
+        g_factor_stats={
+            n: F.compute_g_dense(gout[:, i * M:(i + 1) * M], batch_averaged=True)
+            for i, n in enumerate(split_names)
+        },
+        lr=0.1, damping=0.01, update_factors=True, update_eigen=True)
+
+    for i, n in enumerate(split_names):
+        np.testing.assert_array_equal(
+            np.asarray(gf["qkv"]["kernel"][:, i * M:(i + 1) * M]),
+            np.asarray(gs[n]["kernel"]), err_msg=f"{method}/{n}/kernel")
+        np.testing.assert_array_equal(
+            np.asarray(gf["qkv"]["bias"][i * M:(i + 1) * M]),
+            np.asarray(gs[n]["bias"]), err_msg=f"{method}/{n}/bias")
+
+
+def test_lens_refresh_cost_drops_3x():
+    """The headline FLOP claim: splitting one (S·m)-wide G side into S
+    m-wide sides cuts the eigh refresh from (S·m)³ to S·m³. Pinned
+    structurally off the factor shapes KFAC.init allocates."""
+    _, _, w, b, _, _ = _fused_setup(seed=2)
+
+    def eigh_cubes(kfac, params):
+        state = kfac.init(params)
+        return sum(
+            f["A"].shape[-1] ** 3 + f["G"].shape[-1] ** 3
+            for f in state["factors"].values()
+        )
+
+    fused_names = [f"qkv{capture.SPLIT_SEP}{i}" for i in range(S)]
+    params = {"qkv": {"kernel": w, "bias": b}}
+    split_cost = eigh_cubes(KFAC(damping=0.01, layers=fused_names), params)
+    unsplit_cost = eigh_cubes(KFAC(damping=0.01, layers=["qkv"]), params)
+    assert unsplit_cost >= 3 * split_cost, (split_cost, unsplit_cost)
+
+
+class _FusedQKVNet(nn.Module):
+    """Fused QKV projection under the expand lens + dense head."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        y = KFACDense(S * M, lens_splits=S, name="qkv")(x)
+        return KFACDense(5, name="head")(nn.tanh(y))
+
+
+class _UnfusedQKVNet(nn.Module):
+    """Three narrow projections concatenated — the lens's oracle model."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        y = jnp.concatenate(
+            [KFACDense(M, name=n)(x) for n in ("q", "k", "v")], axis=-1)
+        return KFACDense(5, name="head")(nn.tanh(y))
+
+
+def test_train_step_expand_lens_matches_unfused():
+    """One real jitted K-FAC train step, fused-with-lens vs unfused, with
+    the fused kernel seeded from the unfused slices: parameter updates
+    must agree (forward matmul shapes differ, so allclose not bitwise)."""
+    r = np.random.RandomState(5)
+    x = jnp.asarray(r.randn(B, CIN).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 5, size=B))
+
+    fused, unfused = _FusedQKVNet(), _UnfusedQKVNet()
+    pu = unfused.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    pf = {
+        "qkv": {
+            "kernel": jnp.concatenate(
+                [pu[n]["kernel"] for n in ("q", "k", "v")], axis=-1),
+            "bias": jnp.concatenate([pu[n]["bias"] for n in ("q", "k", "v")]),
+        },
+        "head": pu["head"],
+    }
+
+    def one_step(model, params, batch_x):
+        layers = capture.discover_layers(model, batch_x, train=True)
+        kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                    layers=layers)
+        tx = make_sgd(momentum=0.0)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+            opt_state=tx.init(params), kfac_state=kfac.init(params))
+        step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+        state, _ = step(state, (batch_x, y), jnp.float32(0.1),
+                        jnp.float32(0.01), update_factors=True,
+                        update_eigen=True)
+        return jax.device_get(state.params), layers
+
+    # the train step donates its state: give each run its own param copies
+    new_f, layers_f = one_step(fused, jax.tree_util.tree_map(jnp.copy, pf), x)
+    new_u, _ = one_step(unfused, jax.tree_util.tree_map(jnp.copy, pu), x)
+    assert sorted(layers_f) == sorted(
+        [f"qkv{capture.SPLIT_SEP}{i}" for i in range(S)] + ["head"])
+    for i, n in enumerate(("q", "k", "v")):
+        np.testing.assert_allclose(
+            np.asarray(new_f["qkv"]["kernel"][:, i * M:(i + 1) * M]),
+            np.asarray(new_u[n]["kernel"]), rtol=1e-5, atol=1e-6,
+            err_msg=f"{n}/kernel")
+        np.testing.assert_allclose(
+            np.asarray(new_f["qkv"]["bias"][i * M:(i + 1) * M]),
+            np.asarray(new_u[n]["bias"]), rtol=1e-5, atol=1e-6,
+            err_msg=f"{n}/bias")
+    np.testing.assert_allclose(np.asarray(new_f["head"]["kernel"]),
+                               np.asarray(new_u["head"]["kernel"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+VOCAB, DIM = 13, 6
+
+
+class _TiedLM(nn.Module):
+    """KFACEmbed used at both ends — the reduce-lens shape."""
+
+    def setup(self):
+        self.emb = KFACEmbed(VOCAB, DIM, name="emb")
+
+    def __call__(self, ids, train=True):
+        x = nn.tanh(self.emb(ids))
+        return self.emb.attend(x)
+
+
+def _tied_capture():
+    r = np.random.RandomState(7)
+    ids = jnp.asarray(r.randint(0, VOCAB, size=(4, 5)).astype(np.int32))
+    tgts = jnp.asarray(r.randint(0, VOCAB, size=(4, 5)))
+    model = _TiedLM()
+    params = model.init(jax.random.PRNGKey(1), ids, train=True)["params"]
+    perts = capture.perturbation_zeros(model, ids, train=True)
+
+    def loss_fn(perts):
+        logits, mut = model.apply(
+            {"params": params, PERTURBATIONS: perts}, ids,
+            mutable=[KFAC_ACTS], train=True)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, tgts[..., None], axis=-1))
+        return loss, mut
+    (_, mut), gperts = jax.value_and_grad(loss_fn, has_aux=True)(perts)
+    return model, params, ids, mut[KFAC_ACTS], gperts
+
+
+def test_tied_head_is_one_layer():
+    """Single accumulation: the tied pair discovers as ONE K-FAC layer,
+    and a one-step init carries one diagonal-A factor pair for it."""
+    model, params, ids, _, _ = _tied_capture()
+    layers = capture.discover_layers(model, ids, train=True)
+    assert layers == ["emb"]
+    state = KFAC(damping=0.01, layers=layers).init(params)
+    assert set(state["factors"]) == {"emb"}
+    assert state["factors"]["emb"]["A_diag"].shape == (VOCAB,)
+
+
+def test_tied_statistics_accumulate_once():
+    """Both use sites fold into the single factor pair: A gets token
+    frequencies + the decoder logit-grad diagonal, G gets the embed-site
+    output covariance + the decoder query covariance — each exactly once,
+    bitwise."""
+    model, params, ids, captured, gperts = _tied_capture()
+
+    a = capture.a_contribs(captured, ["emb"], perturb_grads=gperts,
+                           batch_averaged=True)
+    tied_ct = gperts["emb"][OUT_TIED]
+    want_a = F.compute_a_embed(ids, VOCAB) + F.compute_g_diag(
+        tied_ct, batch_averaged=True)
+    np.testing.assert_array_equal(np.asarray(a["emb"]), np.asarray(want_a))
+    # the decoder contribution is real, not a zero no-op
+    assert float(jnp.abs(F.compute_g_diag(tied_ct, batch_averaged=True)).max()) > 0
+
+    g = capture.g_factors(gperts, ["emb"], batch_averaged=True,
+                          captured=captured)
+    query = nn.tanh(jnp.take(params["emb"]["embedding"], ids, axis=0))
+    want_g = F.compute_g_dense(
+        gperts["emb"][OUT_PERTURB], batch_averaged=True
+    ) + F.compute_a_dense(query, has_bias=False)
+    np.testing.assert_array_equal(np.asarray(g["emb"]), np.asarray(want_g))
+
+
+def test_tied_requires_perturb_grads():
+    """Dropping the decoder cotangent would silently halve the tied A
+    statistics — a_contribs must refuse instead."""
+    _, _, _, captured, _ = _tied_capture()
+    with pytest.raises(ValueError, match="tied-head"):
+        capture.a_contribs(captured, ["emb"])
+
+
+def test_tied_trains_through_train_step():
+    """The reduce lens through the real jitted step: tied LM loss drops
+    and the shared table's factor state moves."""
+    r = np.random.RandomState(9)
+    ids = jnp.asarray(r.randint(0, VOCAB, size=(16, 6)).astype(np.int32))
+    tgts = (ids * 5 + 2) % VOCAB
+    model = _TiedLM()
+    params = model.init(jax.random.PRNGKey(2), ids, train=True)["params"]
+    kfac = KFAC(damping=0.003,
+                layers=capture.discover_layers(model, ids, train=True))
+    tx = make_sgd(momentum=0.9)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats={}, opt_state=tx.init(params),
+                       kfac_state=kfac.init(params))
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    losses = []
+    for i in range(25):
+        state, metrics = step(
+            state, (ids, tgts), jnp.float32(0.1), jnp.float32(0.003),
+            update_factors=True, update_eigen=i % 5 == 0)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < 0.7 * losses[0], f"no convergence: {losses[::6]}"
+    assert float(jnp.abs(
+        state.kfac_state["factors"]["emb"]["A_diag"] - 1.0).max()) > 1e-3

@@ -232,16 +232,17 @@ def test_validity_matrix_matches_constructor(lever_name, env_name):
         return
     kfac = construct()
     # train-step-enforced: the comm levers on a multi-axis mesh construct
-    # fine but the explicit-collective wrapper refuses the mesh
+    # fine but the explicit-collective wrapper refuses the mesh (a real
+    # second axis — 'tensor*' axes are exempt, parallel/mesh.py)
     if any(r.enforced_by == "train_step" for r in bad):
-        with pytest.raises(ValueError, match="pure data-parallel"):
+        with pytest.raises(ValueError, match="data-plane mesh"):
             require_pure_dp_mesh(kfac.mesh)
 
 
 def test_matrix_grid_exercises_every_refusal_rule():
     """Completeness: the pairwise grid above must trip every refusal rule
-    at least once except the init-time diag-A rule (covered separately) —
-    otherwise the matrix has rows no test holds to reality."""
+    at least once — otherwise the matrix has rows no test holds to
+    reality."""
     tripped = set()
     for plan in _LEVERS.values():
         for env_kw, _ in _ENVS.values():
@@ -252,13 +253,14 @@ def test_matrix_grid_exercises_every_refusal_rule():
                 world=world, mesh_axes=axes if world > 1 else (), **env_kw
             )
             tripped |= {r.name for r in violations(plan, env)}
-    expected = {r.name for r in REFUSAL_RULES} - {"owner_vs_diag_a_layers"}
+    expected = {r.name for r in REFUSAL_RULES}
     assert expected <= tripped, expected - tripped
 
 
-def test_owner_diag_a_rule_matches_init_refusal():
-    """The one init()-enforced rule: predicted by the matrix from model
-    facts, actually raised by KFAC.init on an embedding model."""
+def test_owner_accepts_diag_a_layers():
+    """PR-6's owner_vs_diag_a_layers refusal is gone: owner sharding lays
+    embedding A factors out as [vocab] vector slots (v-groups), so the
+    matrix predicts valid AND init actually builds the sharded state."""
 
     class EmbedNet(nn.Module):
         @nn.compact
@@ -277,19 +279,21 @@ def test_owner_diag_a_rule_matches_init_refusal():
     facts = model_facts(params, layers=layers)
     assert facts.has_diag_a
     env = _env(world=8, has_diag_a_layers=True)
-    bad = violations(Plan(factor_sharding="owner"), env)
-    assert [r.name for r in bad] == ["owner_vs_diag_a_layers"]
-    assert all(r.enforced_by == "init" for r in bad)
+    assert violations(Plan(factor_sharding="owner"), env) == []
+    fitted, dropped = fit_plan(Plan(factor_sharding="owner"), env)
+    assert fitted.factor_sharding == "owner" and not dropped
     kfac = KFAC(
         damping=0.01, mesh=data_parallel_mesh(), factor_sharding="owner",
         layers=layers,
     )
-    with pytest.raises(ValueError, match="diagonal-A"):
-        kfac.init(params)
-    # and fit_plan resolves it the way resolve_profile would: drop owner
-    fitted, dropped = fit_plan(Plan(factor_sharding="owner"), env)
-    assert fitted.factor_sharding == "replicated"
-    assert "owner_vs_diag_a_layers" in dropped
+    state = kfac.init(params)
+    # the vocab-side diag factor lives in a v-group stack, not a matrix
+    plan = kfac._shard_plan(*kfac._owner_shapes(
+        {"emb": {"A_diag": jnp.ones((16,)), "G": jnp.zeros((8, 8))},
+         "fc": {"A": jnp.eye(9), "G": jnp.zeros((4, 4))}}
+    ))
+    assert plan.diag_group_sizes == (16,)
+    assert any(k.startswith("v") for k in state["factor_shard"])
 
 
 def test_degrade_rules_match_constructor_warnings():

@@ -142,6 +142,19 @@ def test_no_bytecode_artifacts_tracked():
     assert not bad, f"bytecode artifacts tracked by git: {bad}"
 
 
+def test_no_scratch_files_tracked():
+    """scratch/ is the local workbench (.gitignore'd) — session experiments
+    and one-off probes must never ship in the repo history."""
+    res = subprocess.run(
+        ["git", "ls-files", "scratch"], capture_output=True, text=True,
+        cwd=REPO,
+    )
+    if res.returncode != 0:
+        pytest.skip("not a git checkout")
+    bad = res.stdout.splitlines()
+    assert not bad, f"scratch files tracked by git: {bad}"
+
+
 def test_bench_cpu_fallback_emits_json():
     """bench.py must emit parseable, schema-complete JSON with rc=0 even
     when the TPU backend never comes up: the probe subprocess (stubbed here
