@@ -878,6 +878,78 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
     return out
 
 
+def _resume_arm(rec, batch, size, fac_freq, kfac_freq):
+    """-resume: elastic snapshot/scan-resume smoke (docs/ELASTIC.md).
+
+    Runs a short training burst with ``Supervisor(snapshot_every=2)`` and
+    reports the step-loop cost of a snapshot — ``snapshot_duration_ms``
+    p50/p95, the number operators budget ``--snapshot-every`` against —
+    then proves the newest snapshot actually scan-resumes and steps."""
+    import shutil
+    import tempfile
+
+    from kfac_pytorch_tpu import KFAC, EigenRefreshCadence, elastic
+    from kfac_pytorch_tpu.models import imagenet_resnet
+    from kfac_pytorch_tpu.training.step import (
+        TrainState, make_sgd, make_train_step,
+    )
+
+    model = imagenet_resnet.get_model(
+        os.environ.get("KFAC_BENCH_MODEL", "resnet50")
+    )
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=batch).astype(np.int32))
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros_like(images), train=True
+    )
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    tx = make_sgd(momentum=0.9, weight_decay=5e-5)
+    kfac = KFAC(damping=0.001, fac_update_freq=fac_freq,
+                kfac_update_freq=kfac_freq)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        batch_stats=batch_stats, opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    step_fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    lr, damping = jnp.float32(0.1), jnp.float32(0.001)
+    cad = EigenRefreshCadence(kfac)
+    save_dir = tempfile.mkdtemp(prefix="kfac-bench-resume-")
+    sup = elastic.Supervisor(save_dir, snapshot_every=2, kfac=kfac,
+                             cadence=cad)
+    try:
+        step = 0
+        for _ in range(6):
+            flags = cad.flags_for_step(step)
+            state, _m = step_fn(state, (images, labels), lr, damping, **flags)
+            step += 1
+            sup.on_step(step, lambda: state)
+        sup.wait()
+        durs = sup.snapshot_durations_ms
+        rec["snapshots"] = len(durs)
+        rec["snapshot_duration_ms_p50"] = round(
+            float(np.percentile(durs, 50)), 2)
+        rec["snapshot_duration_ms_p95"] = round(
+            float(np.percentile(durs, 95)), 2)
+        # the round-trip half: the newest snapshot must scan-resume into a
+        # state a further step accepts
+        cad2 = EigenRefreshCadence(kfac)
+        sup2 = elastic.Supervisor(save_dir, kfac=kfac, cadence=cad2)
+        hit = sup2.scan_resume(jax.device_get(state), params=state.params)
+        if hit is None:
+            raise RuntimeError("no complete snapshot found after burst")
+        rstate, _manifest, rstep = hit
+        rstate, _m = step_fn(
+            rstate, (images, labels), lr, damping,
+            **cad2.flags_for_step(rstep)
+        )
+        rec["resume_step"] = int(rstep)
+        rec["resume_ok"] = True
+    finally:
+        shutil.rmtree(save_dir, ignore_errors=True)
+
+
 def _transformer_bench(fac_freq, kfac_freq):
     """Flash-vs-naive attention + LM K-FAC tax. Each sub-arm is individually
     guarded: a flash-kernel failure on real hardware (never yet run there —
@@ -1058,10 +1130,28 @@ def main():
               eigen_dtype=jnp.bfloat16), True),
         ("inverse", "-inv", batch, None, dict(precond_method="inverse"), True),
         ("bf16", "-bf16", batch, jnp.bfloat16, {}, False),
+        # -resume: elastic snapshot/scan-resume smoke — snapshot_duration_ms
+        # p50/p95 (the step-loop cost --snapshot-every is budgeted against)
+        # plus a restore-and-step round-trip (docs/ELASTIC.md)
+        ("resume", "-resume", batch, None, {}, False),
     ]
     only = os.environ.get("KFAC_BENCH_ARMS")  # comma-list of keys to run
     for key, tag, arm_batch, dtype, kwargs, reuse in arm_list:
         if only and key not in only.split(","):
+            continue
+        if key == "resume":
+            if _elapsed() > cutoff:
+                _ARMS[key] = {"tag": tag, "skipped": "arm_cutoff"}
+            else:
+                _ARMS[key] = {"tag": tag}
+                try:
+                    _resume_arm(_ARMS[key], arm_batch, size,
+                                fac_freq, kfac_freq)
+                except Exception as e:  # noqa: BLE001 — arms are independent
+                    _log(f"arm {key} failed: {type(e).__name__}: {e}")
+                    _ARMS[key].update(
+                        error=f"{type(e).__name__}: {e}"[:300])
+            _emit(partial=True)
             continue
         if key == "inverse_aggressive_b64" and "overhead_pct" in _ARMS.get(
             "inverse_aggressive_b128", {}

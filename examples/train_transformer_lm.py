@@ -69,6 +69,13 @@ def parse_args(argv=None):
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--log-dir", default="./logs")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--preempt-save-dir", default=None,
+                   help="elastic snapshot dir: SIGTERM takes an emergency "
+                        "snapshot and a restart scan-resumes the newest one "
+                        "(docs/ELASTIC.md)")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="elastic: also snapshot every N steps "
+                        "(needs --preempt-save-dir; 0 = emergency-only)")
     p.add_argument("--d-model", type=int, default=256)
     p.add_argument("--n-heads", type=int, default=4)
     p.add_argument("--n-layers", type=int, default=2)
@@ -508,6 +515,40 @@ def main(argv=None):
     # host-side refresh cadence: identical to kfac_flags_for_step at
     # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
     cadence = EigenRefreshCadence(kfac)
+
+    sup = None
+    resume_skip = 0
+    if args.preempt_save_dir:
+        from kfac_pytorch_tpu import elastic
+
+        sup = elastic.Supervisor(
+            args.preempt_save_dir, snapshot_every=args.snapshot_every,
+            kfac=kfac, cadence=cadence,
+            heartbeat_every=max(1, args.snapshot_every or steps_per_epoch),
+            fault_injector=elastic.maybe_injector(),
+        )
+        sup.install_signal_handlers()
+        hit = sup.scan_resume(jax.device_get(state), params=state.params)
+        if hit is not None:
+            state, _manifest, step = hit
+            # re-place exactly like a cold start: owner-sharded kfac_state
+            # keeps the placement scan_resume gave it, everything else
+            # (including replicated-mode kfac_state, which rehome passes
+            # through as host arrays) is replicated over the mesh
+            if kfac is not None and kfac.owner_sharded:
+                kstate = state.kfac_state
+                state = jax.device_put(
+                    state.replace(kfac_state=None), NamedSharding(mesh, P())
+                )
+                state = state.replace(kfac_state=kstate)
+            else:
+                state = jax.device_put(state, NamedSharding(mesh, P()))
+            resume_from_epoch = step // steps_per_epoch
+            resume_skip = step % steps_per_epoch
+            if launch.is_primary():
+                print(f"elastic: resumed from snapshot at step {step}")
+    preempted = False
+
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
@@ -533,6 +574,8 @@ def main(argv=None):
             for i, batch in enumerate(sharded_bptt_batches(stream)):
                 if i >= steps_per_epoch:
                     break
+                if epoch == resume_from_epoch and i < resume_skip:
+                    continue  # mid-epoch snapshot resume: keep i == step phase
                 flags = cadence.flags_for_step(step, epoch)
                 if flags.get("eigen_chunk") is not None:
                     sp_t = tel.span("step/eigen_chunk")
@@ -551,12 +594,19 @@ def main(argv=None):
                     sp_t.block(metrics)
                 step += 1
                 pending.append(metrics)
+                if sup is not None and sup.on_step(step, lambda: state):
+                    preempted = True
+                    break
                 if len(pending) > 2:
                     with tel.span("comm/device_get"):
                         m = jax.device_get(pending.pop(0))
                     eat(m)
             for m in jax.device_get(pending):
                 eat(m)
+        if preempted:
+            if launch.is_primary():
+                print(f"elastic: preempted; snapshot at step {step} saved")
+            break
         dt = time.perf_counter() - t0
         ppl = float(np.exp(min(loss_m.avg, 20.0)))
         if launch.is_primary():
@@ -614,6 +664,8 @@ def main(argv=None):
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
 
+    if sup is not None:
+        sup.wait()  # join any in-flight background snapshot write
     if tel.enabled:
         table = observability.summary_table(tel)  # collective: every rank
         if launch.is_primary():

@@ -66,6 +66,13 @@ def parse_args(argv=None):
     p.add_argument("--synthetic", action="store_true")
     p.add_argument("--log-dir", default="./logs")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--preempt-save-dir", default=None,
+                   help="elastic snapshot dir: SIGTERM takes an emergency "
+                        "snapshot and a restart scan-resumes the newest one "
+                        "(docs/ELASTIC.md)")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="elastic: also snapshot every N steps "
+                        "(needs --preempt-save-dir; 0 = emergency-only)")
     p.add_argument("--model", default="LSTM",
                    choices=list(wikitext_rnn.RNN_TYPES))
     p.add_argument("--emsize", type=int, default=650)
@@ -305,6 +312,46 @@ def main(argv=None):
     # host-side refresh cadence: identical to kfac_flags_for_step at
     # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
     cadence = EigenRefreshCadence(kfac)
+    max_steps = (train_stream.shape[1] - 1) // args.bptt
+    steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
+
+    sup = None
+    resume_skip = 0
+    if args.preempt_save_dir:
+        from kfac_pytorch_tpu import elastic
+
+        sup = elastic.Supervisor(
+            args.preempt_save_dir, snapshot_every=args.snapshot_every,
+            kfac=kfac, cadence=cadence,
+            heartbeat_every=max(1, args.snapshot_every or steps_per_epoch),
+            fault_injector=elastic.maybe_injector(),
+        )
+        sup.install_signal_handlers()
+        hit = sup.scan_resume(jax.device_get(state), params=state.params)
+        if hit is not None:
+            state, _manifest, step = hit
+            # re-place exactly like a cold start (stray host-numpy leaves
+            # would compile the step once more): owner-sharded kfac_state
+            # keeps the placement scan_resume gave it, everything else is
+            # replicated / default-device
+            if kfac is not None and kfac.owner_sharded:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                kstate = state.kfac_state
+                state = jax.device_put(
+                    state.replace(kfac_state=None), NamedSharding(mesh, P())
+                )
+                state = state.replace(kfac_state=kstate)
+            elif mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                state = jax.device_put(state, NamedSharding(mesh, P()))
+            else:
+                state = jax.device_put(state)
+            resume_from_epoch = step // steps_per_epoch
+            resume_skip = step % steps_per_epoch
+            print(f"elastic: resumed from snapshot at step {step}")
+    preempted = False
 
     def fresh_carry():
         # zero carry for an epoch start, committed to the mesh so epoch
@@ -329,9 +376,11 @@ def main(argv=None):
         for i, (xb, yb) in enumerate(
             data_lib.bptt_batches(train_stream, args.bptt)
         ):
-            if args.steps_per_epoch and i >= args.steps_per_epoch:
+            if i >= steps_per_epoch:
                 break
             rng, sub = jax.random.split(rng)
+            if epoch == resume_from_epoch and i < resume_skip:
+                continue  # mid-epoch snapshot resume: keep i/rng == step phase
             flags = cadence.flags_for_step(step, epoch)
             state, carry, metrics = train_step(
                 state, (jnp.asarray(xb), jnp.asarray(yb)), carry, sub,
@@ -341,6 +390,12 @@ def main(argv=None):
             step += 1
             n_steps += 1
             loss_m.update(jax.device_get(metrics["loss"]))
+            if sup is not None and sup.on_step(step, lambda: state):
+                preempted = True
+                break
+        if preempted:
+            print(f"elastic: preempted; snapshot at step {step} saved")
+            break
         dt = time.perf_counter() - t0
         ppl = math.exp(min(loss_m.avg, 20))
         print(f"epoch {epoch}: loss={loss_m.avg:.4f} ppl={ppl:.1f} "
@@ -365,6 +420,8 @@ def main(argv=None):
         if args.checkpoint_dir:
             ckpt.save_checkpoint(args.checkpoint_dir, epoch, state)
 
+    if sup is not None:
+        sup.wait()  # join any in-flight background snapshot write
     writer.close()
     return state
 

@@ -27,6 +27,17 @@ __all__ = [
     "KFACParamScheduler",
     "EigenRefreshCadence",
     "capture",
+    "elastic",
     "ops",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # the elastic runtime pulls in orbax; load it on first touch so plain
+    # `import kfac_pytorch_tpu` stays cheap for non-checkpointing users
+    if name == "elastic":
+        import importlib
+
+        return importlib.import_module("kfac_pytorch_tpu.elastic")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,54 @@
+"""Elastic runtime: preemption-tolerant, mesh-resizable K-FAC.
+
+ROADMAP item 5. Composed with owner-sharded factor state, this is what
+lets the optimizer ride bursty multi-tenant pods instead of a fixed
+research slice: the full curvature state is durable
+(:mod:`~kfac_pytorch_tpu.elastic.state_io`), the layer→owner plan is
+re-derivable deterministically on a resized mesh
+(:mod:`~kfac_pytorch_tpu.elastic.replan`), the host loop snapshots on
+preemption and resumes by scan (:mod:`~kfac_pytorch_tpu.elastic.supervisor`),
+and every recovery path is testable on CPU via deterministic fault
+injection (:mod:`~kfac_pytorch_tpu.elastic.faults`). Operator guide:
+docs/ELASTIC.md.
+"""
+
+from kfac_pytorch_tpu.elastic import faults, replan, state_io, supervisor
+from kfac_pytorch_tpu.elastic.faults import (
+    FaultInjector,
+    FaultSpec,
+    SimulatedPreemption,
+    maybe_injector,
+)
+from kfac_pytorch_tpu.elastic.replan import replan_state, resize_owner_state
+from kfac_pytorch_tpu.elastic.state_io import (
+    KFAC_STATE_KEYS,
+    SnapshotError,
+    latest_snapshot,
+    list_snapshots,
+    load_manifest,
+    restore_snapshot,
+    save_snapshot,
+)
+from kfac_pytorch_tpu.elastic.supervisor import Preempted, Supervisor
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "KFAC_STATE_KEYS",
+    "Preempted",
+    "SimulatedPreemption",
+    "SnapshotError",
+    "Supervisor",
+    "faults",
+    "latest_snapshot",
+    "list_snapshots",
+    "load_manifest",
+    "maybe_injector",
+    "replan",
+    "replan_state",
+    "resize_owner_state",
+    "restore_snapshot",
+    "save_snapshot",
+    "state_io",
+    "supervisor",
+]

@@ -1,0 +1,220 @@
+"""Deterministic re-planning of owner-sharded K-FAC state on a resized mesh.
+
+Owner sharding made curvature memory O(model/devices) but placed every
+factor by the LPT assignment in ``parallel/assignment.py`` — so state
+placement became a function of the mesh, and surviving a resize means
+re-deriving that placement for the new world and moving every slot's rows.
+The assignment is a pure function of (layer shapes, world): every host
+re-derives the same plan from params alone
+(:meth:`KFAC.factor_shapes`), which is what makes the replan deterministic
+— the property arxiv 2007.00784 relies on for its round-robin inverse
+assignment, inherited here by the LPT layout.
+
+The re-scatter is a direct row remap between shard stacks: for each slot in
+the NEW plan, copy its row out of the OLD plan's stack at
+``old_owner * old_rows + old_row``. A restored snapshot already presents the
+stacks as host-global arrays (orbax reads them shard-by-shard on each
+host), so the remap is pure host indexing plus one ``device_put`` against
+the new mesh's shardings — never a gather of per-layer factors to host 0.
+
+What survives a resize, and what is deliberately dropped:
+
+* factor EMAs and ACTIVE eigen bases/rsvd tables — carried bitwise (rows
+  move, values do not);
+* a half-filled ``eigen_pending`` pass — abandoned (zeroed): the old
+  mesh's chunk plan is meaningless on the new world, and the cadence
+  rebuilds the pass from chunk 0 at the next refresh boundary. Cost: the
+  active basis is at most ONE refresh interval stale after a resize — the
+  elastic contract documented in docs/ELASTIC.md;
+* unflushed deferred accumulators (``factor_local``/``factor_sync_age``) —
+  zeroed: they are per-replica quantities of a replica set that no longer
+  exists. Snapshot on a flush boundary (the supervisor's default cadence
+  aligns to it) to make this lossless;
+* ``eigen_swap_slip`` — reset; the slipped swap's pending basis did not
+  survive, so there is nothing left to promote.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.parallel.assignment import (
+    plan_factor_shards,
+    plan_fingerprint,
+)
+
+_REPLANS = {"count": 0}
+
+
+def _remap_rows(
+    old: np.ndarray,
+    new: np.ndarray,
+    old_plan,
+    new_plan,
+    size: int,
+    diag: bool,
+) -> np.ndarray:
+    """Copy every slot's row(s) from the old stack layout into the new."""
+    old_rows = (old_plan.diag_group_rows if diag else old_plan.group_rows)[size]
+    new_rows = (new_plan.diag_group_rows if diag else new_plan.group_rows)[size]
+    for s_new in new_plan.group_slots(size, diag):
+        s_old = old_plan.slot(s_new.name, s_new.factor)
+        new[s_new.owner * new_rows + s_new.row] = old[
+            s_old.owner * old_rows + s_old.row
+        ]
+    return new
+
+
+def resize_owner_state(
+    kfac: Any,
+    state: Dict[str, Any],
+    params: Any,
+    old_world: int,
+    expect_fingerprint: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Re-home an owner-form state saved on an ``old_world``-replica mesh
+    onto ``kfac``'s (differently sized) mesh.
+
+    ``kfac`` is the preconditioner built for the NEW mesh
+    (``factor_sharding="owner"``); ``state`` is the restored owner-form
+    K-FAC state (host-global arrays); ``params`` is the model's parameter
+    pytree — the shape oracle both plans derive from. Passing the
+    manifest's ``shard_plan_fingerprint`` as ``expect_fingerprint`` verifies
+    the re-derived old plan matches the layout that actually wrote the
+    stacks, failing loudly on drift instead of reading rows from the wrong
+    owners.
+    """
+    if not getattr(kfac, "owner_sharded", False):
+        raise ValueError(
+            "resize_owner_state() needs the target preconditioner in "
+            "factor_sharding='owner'"
+        )
+    if "factor_shard" not in state:
+        raise ValueError(
+            "resize_owner_state() takes an owner-form state (has "
+            "'factor_shard'); replicated states are mesh-independent — "
+            "rehome them via training.checkpoint.rehome_kfac_state"
+        )
+    shapes, diag_a = kfac.factor_shapes(params)
+    old_plan = plan_factor_shards(
+        shapes,
+        int(old_world),
+        kfac.factor_comm.max_bucket_elems,
+        diag_a=set(diag_a),
+    )
+    if expect_fingerprint is not None:
+        derived = plan_fingerprint(old_plan)
+        if derived != expect_fingerprint:
+            raise ValueError(
+                f"re-derived owner-shard plan for world={old_world} has "
+                f"fingerprint {derived}, but the snapshot was laid out as "
+                f"{expect_fingerprint} — shapes or the LPT policy changed "
+                f"since it was written"
+            )
+    new_plan = kfac._shard_plan(shapes, frozenset(diag_a))
+
+    factor_shard = {}
+    for n in new_plan.group_sizes:
+        rows = new_plan.world * new_plan.group_rows[n]
+        factor_shard[f"n{n}"] = jnp.asarray(_remap_rows(
+            np.asarray(jax.device_get(state["factor_shard"][f"n{n}"])),
+            np.zeros((rows, n, n), np.float32),
+            old_plan, new_plan, n, diag=False,
+        ))
+    for n in new_plan.diag_group_sizes:
+        rows = new_plan.world * new_plan.diag_group_rows[n]
+        factor_shard[f"v{n}"] = jnp.asarray(_remap_rows(
+            np.asarray(jax.device_get(state["factor_shard"][f"v{n}"])),
+            np.zeros((rows, n), np.float32),
+            old_plan, new_plan, n, diag=True,
+        ))
+
+    eigen_shard = {}
+    for key, grp in kfac._owner_zero_eigen_shard(new_plan).items():
+        n = int(key[1:])
+        diag = key.startswith("v")
+        eigen_shard[key] = {
+            leaf: jnp.asarray(_remap_rows(
+                np.asarray(jax.device_get(state["eigen_shard"][key][leaf])),
+                np.array(jax.device_get(zero)),
+                old_plan, new_plan, n, diag=diag,
+            ), grp[leaf].dtype)
+            for leaf, zero in grp.items()
+        }
+
+    new_state: Dict[str, Any] = {
+        "step": jnp.asarray(jax.device_get(state["step"]), jnp.int32),
+        "factors": jax.tree_util.tree_map(
+            lambda leaf: jnp.asarray(leaf, jnp.float32), state["factors"]
+        ),
+        "eigen": {},
+        "eigen_stacked": {},
+        "factor_shard": factor_shard,
+        "eigen_shard": eigen_shard,
+    }
+    if kfac.eigh_chunks > 1:
+        # abandon any half-filled pending pass: the old chunk plan does not
+        # exist on this world; the next boundary rebuilds from chunk 0
+        new_state["eigen_pending_shard"] = jax.tree_util.tree_map(
+            jnp.zeros_like, eigen_shard
+        )
+    if kfac.solver == "rsvd":
+        new_state["spectrum_mass"] = jnp.asarray(
+            jax.device_get(state.get("spectrum_mass", 0.0)), jnp.float32
+        )
+    if kfac.factor_comm.defer:
+        new_state["factor_local"] = {
+            name: {
+                "A": jnp.zeros(
+                    (shapes[name][1],) * (1 if name in diag_a else 2),
+                    jnp.float32,
+                ),
+                "G": jnp.zeros((shapes[name][0],) * 2, jnp.float32),
+            }
+            for name in shapes
+        }
+        new_state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+    if kfac.staleness_budget > 0:
+        new_state["eigen_swap_slip"] = jnp.zeros((), jnp.int32)
+
+    _REPLANS["count"] += 1
+    get_telemetry().set_gauge("kfac/replan_count", _REPLANS["count"])
+    return jax.device_put(new_state, kfac.state_shardings(new_state))
+
+
+def replan_state(
+    kfac: Any,
+    state: Any,
+    params: Any,
+    old_world: int,
+    expect_fingerprint: Optional[str] = None,
+) -> Any:
+    """One entry for every restore case the elastic runtime meets.
+
+    * target replicated (or no kfac) — the state is mesh-independent;
+      rehome through the existing checkpoint machinery (which refuses
+      owner-form states it cannot gather back);
+    * target owner, same world, owner-form snapshot — bitwise ``device_put``
+      (fingerprints verified when provided);
+    * target owner, different world — the full :func:`resize_owner_state`
+      remap;
+    * target owner, replicated-form snapshot — the existing deterministic
+      ``owner_state_from_replicated`` re-scatter.
+    """
+    from kfac_pytorch_tpu.training import checkpoint as _ckpt
+
+    if kfac is None or state is None:
+        return state
+    owner_form = isinstance(state, dict) and "factor_shard" in state
+    if not getattr(kfac, "owner_sharded", False) or not owner_form:
+        return _ckpt.rehome_kfac_state(kfac, state)
+    if int(old_world) == int(kfac._data_world()):
+        return jax.device_put(state, kfac.state_shardings(state))
+    return resize_owner_state(
+        kfac, state, params, old_world, expect_fingerprint=expect_fingerprint
+    )

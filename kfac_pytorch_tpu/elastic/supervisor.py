@@ -1,0 +1,291 @@
+"""Host-side elastic supervision: snapshots, preemption, liveness.
+
+The loop the trainers wire between steps (``--snapshot-every`` /
+``--preempt-save-dir``). Everything here is host Python — no traced code,
+no new step variants — so supervision composes with every lever without
+touching the compiled program:
+
+* **periodic async snapshots** — every ``snapshot_every`` steps the live
+  TrainState is pulled to host (the only part the step blocks on; its
+  duration is the bounded overhead) and an orbax write + manifest commit
+  runs on a background thread. ``kfac/snapshot_duration_ms`` reports the
+  blocking portion; the writer thread is joined before the next snapshot
+  (and before any emergency save) so at most one write is ever in flight;
+* **SIGTERM/preemption-triggered emergency snapshot** —
+  :meth:`install_signal_handlers` flips a flag; the next
+  :meth:`on_step` takes a SYNCHRONOUS snapshot and tells the trainer to
+  stop. Cloud preemption notices (TPU maintenance events deliver SIGTERM)
+  therefore lose at most the steps since the last completed one;
+* **restart-scan-resume** — :meth:`scan_resume` picks the newest COMPLETE
+  snapshot (``state_io.latest_snapshot`` skips truncated/corrupt
+  directories), restores through the sharding-aware path, re-homes the
+  K-FAC state for the current mesh (including the deterministic resize
+  replan when the world changed), and reloads the refresh-cadence state so
+  mid-interval resumes are exact;
+* **per-host liveness heartbeat** — each host writes a timestamped beat
+  under ``<save_dir>/heartbeats/``; ``kfac/host_liveness`` gauges how many
+  hosts beat within the window. On shared storage this is the cheap
+  cross-host health signal a pod scheduler (or a human) can watch.
+
+Multi-process runs force snapshots synchronous: the orbax write is a
+collective over processes, and driving a collective from a per-host
+background thread would deadlock against the step stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from kfac_pytorch_tpu.elastic import replan as _replan
+from kfac_pytorch_tpu.elastic import state_io
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+
+_HEARTBEAT_DIR = "heartbeats"
+
+
+class Preempted(RuntimeError):
+    """Raised by trainers that prefer an exception over a stop-flag."""
+
+
+class Supervisor:
+    """One per process. See the module docstring for the contract."""
+
+    def __init__(
+        self,
+        save_dir: str,
+        snapshot_every: int = 0,
+        keep: int = 2,
+        kfac: Any = None,
+        cadence: Any = None,
+        heartbeat_every: int = 0,
+        liveness_window_s: float = 300.0,
+        async_snapshots: bool = True,
+        fault_injector: Any = None,
+    ):
+        self.save_dir = os.path.abspath(save_dir)
+        self.snapshot_every = int(snapshot_every)
+        self.keep = max(1, int(keep))
+        self.kfac = kfac
+        self.cadence = cadence
+        self.heartbeat_every = int(heartbeat_every)
+        self.liveness_window_s = float(liveness_window_s)
+        # a multi-process orbax save is a collective: never run it off-thread
+        self.async_snapshots = bool(async_snapshots) and jax.process_count() == 1
+        self.fault_injector = fault_injector
+        self.preempt_requested = False
+        self.last_snapshot_step: Optional[int] = None
+        self.snapshot_durations_ms: list = []
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: list = []
+        if jax.process_index() == 0:
+            os.makedirs(self.save_dir, exist_ok=True)
+
+    # -- signals ------------------------------------------------------
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,)) -> None:
+        """Route preemption signals into the stop-and-snapshot path. Only
+        flips a flag — safe inside a running jitted step; the snapshot
+        happens at the next :meth:`on_step` boundary."""
+        for sig in signals:
+            signal.signal(sig, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        self.preempt_requested = True
+
+    # -- snapshots ----------------------------------------------------
+
+    def wait(self) -> None:
+        """Join any in-flight background snapshot write."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+        if self._writer_error:
+            err = self._writer_error.pop()
+            raise state_io.SnapshotError(
+                f"background snapshot write failed: {err}"
+            )
+
+    def snapshot(
+        self,
+        step: int,
+        state: Any,
+        extra: Optional[Dict[str, Any]] = None,
+        sync: bool = False,
+    ) -> str:
+        """Write ``snap-<step>``; async by default (see module docstring).
+
+        Returns the snapshot path immediately; for async writes the
+        manifest appears once the background write commits.
+        """
+        self.wait()
+        t0 = time.monotonic()
+        snap = state_io.snapshot_dir(self.save_dir, step)
+        # per-replica factor_local shards must be read while the live
+        # arrays are addressable — device_get alone keeps only device 0's
+        state, packed = state_io.pack_replica_local(
+            state, getattr(self.kfac, "mesh", None)
+        )
+        if self.async_snapshots and not sync:
+            host_state = jax.device_get(state)  # the bounded step overhead
+
+            def _write():
+                try:
+                    state_io.save_snapshot(
+                        self.save_dir, step, host_state,
+                        kfac=self.kfac, cadence=self.cadence, extra=extra,
+                        packed_replica_local=packed,
+                    )
+                    self._gc()
+                except Exception as e:  # noqa: BLE001 — surfaced via wait()
+                    self._writer_error.append(f"{type(e).__name__}: {e}")
+
+            self._writer = threading.Thread(
+                target=_write, name="kfac-snapshot", daemon=True
+            )
+            self._writer.start()
+        else:
+            state_io.save_snapshot(
+                self.save_dir, step, state,
+                kfac=self.kfac, cadence=self.cadence, extra=extra,
+                packed_replica_local=packed,
+            )
+            self._gc()
+        dur_ms = (time.monotonic() - t0) * 1e3
+        self.snapshot_durations_ms.append(dur_ms)
+        self.last_snapshot_step = int(step)
+        tel = get_telemetry()
+        tel.set_gauge("kfac/snapshot_duration_ms", dur_ms)
+        tel.set_gauge("kfac/snapshot_age_steps", 0)
+        return snap
+
+    def _gc(self) -> None:
+        """Drop all but the newest ``keep`` complete snapshots (process 0)."""
+        if jax.process_index() != 0:
+            return
+        snaps = state_io.list_snapshots(self.save_dir)
+        for _, path in snaps[: -self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- the per-step hook --------------------------------------------
+
+    def on_step(
+        self,
+        step: int,
+        state_fn: Callable[[], Any],
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Call once per completed step. Returns True when training must
+        stop NOW (preemption observed; the emergency snapshot is already on
+        disk). ``state_fn`` is zero-arg so the state is only materialized
+        when a snapshot is actually due.
+        """
+        if self.fault_injector is not None:
+            self.fault_injector.on_step(step, self)
+        tel = get_telemetry()
+        if self.preempt_requested:
+            self.snapshot(step, state_fn(), extra=extra, sync=True)
+            self.wait()
+            return True
+        if self.snapshot_every > 0 and step > 0 and (
+            step % self.snapshot_every == 0
+        ):
+            self.snapshot(step, state_fn(), extra=extra)
+        if self.heartbeat_every > 0 and step % self.heartbeat_every == 0:
+            self.heartbeat(step)
+            tel.set_gauge("kfac/host_liveness", self.liveness())
+        age = (
+            step if self.last_snapshot_step is None
+            else step - self.last_snapshot_step
+        )
+        tel.set_gauge("kfac/snapshot_age_steps", age)
+        return False
+
+    # -- resume -------------------------------------------------------
+
+    def scan_resume(
+        self, target: Any, params: Any = None
+    ) -> Optional[Tuple[Any, Dict[str, Any], int]]:
+        """``(state, manifest, resume_step)`` from the newest complete
+        snapshot, or None when the directory holds none.
+
+        The restored K-FAC state is re-homed for ``self.kfac``'s mesh; when
+        the snapshot's data world differs from the current one and
+        ``params`` is given, the deterministic resize replan re-scatters
+        the owner stacks (docs/ELASTIC.md "Resize semantics").
+        """
+        found = state_io.latest_snapshot(self.save_dir)
+        if found is None:
+            return None
+        step, snap = found
+        manifest = state_io.load_manifest(snap)
+        kstate_needs_replan = (
+            self.kfac is not None
+            and params is not None
+            and manifest.get("sharding") == "owner"
+            and getattr(self.kfac, "owner_sharded", False)
+            and int(manifest.get("world") or 0) != int(self.kfac._data_world())
+        )
+        state, manifest = state_io.restore_snapshot(
+            snap,
+            target,
+            kfac=None if kstate_needs_replan else self.kfac,
+            cadence=self.cadence,
+        )
+        if kstate_needs_replan:
+            kstate = state_io.kfac_state_of(state)
+            rehomed = _replan.replan_state(
+                self.kfac,
+                kstate,
+                params,
+                int(manifest["world"]),
+                expect_fingerprint=manifest.get("shard_plan_fingerprint"),
+            )
+            if hasattr(state, "replace"):
+                state = state.replace(kfac_state=rehomed)
+            else:
+                state = rehomed
+        return state, manifest, int(manifest.get("step", step))
+
+    # -- liveness -----------------------------------------------------
+
+    def _heartbeat_path(self, host: Optional[int] = None) -> str:
+        host = jax.process_index() if host is None else host
+        return os.path.join(
+            self.save_dir, _HEARTBEAT_DIR, f"host-{host}.json"
+        )
+
+    def heartbeat(self, step: int) -> None:
+        """Write this host's beat (atomic rename, shared-storage safe)."""
+        path = self._heartbeat_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"t": time.time(), "step": int(step)}, fh)
+        os.replace(tmp, path)
+
+    def liveness(self) -> int:
+        """Hosts whose last beat is within the liveness window."""
+        d = os.path.join(self.save_dir, _HEARTBEAT_DIR)
+        if not os.path.isdir(d):
+            return 0
+        now = time.time()
+        live = 0
+        for name in os.listdir(d):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as fh:
+                    beat = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if now - float(beat.get("t", 0)) <= self.liveness_window_s:
+                live += 1
+        return live

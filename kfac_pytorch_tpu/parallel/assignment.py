@@ -448,6 +448,29 @@ def shard_plan_bytes(
     }
 
 
+def plan_fingerprint(plan: FactorShardPlan) -> str:
+    """Short stable digest of an owner-shard layout.
+
+    Hashes exactly what placement depends on — world size plus every slot's
+    ``(name, factor, size, owner, row, diag)`` in deterministic slot order.
+    Snapshot manifests record it, and the elastic replan path re-derives the
+    plan from shapes + world and compares digests: a mismatch means the
+    checkpoint was laid out by a different LPT decision than the one this
+    binary would make, which must fail loudly instead of silently reading
+    rows from the wrong owners.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(str(plan.world).encode())
+    for s in sorted(plan.slots, key=lambda s: (s.name, s.factor)):
+        h.update(
+            f"|{s.name}:{s.factor}:{s.size}:{s.owner}:{s.row}:"
+            f"{int(s.diag)}".encode()
+        )
+    return h.hexdigest()[:16]
+
+
 def plan_owner_chunks(
     plan: FactorShardPlan,
     chunks: int,

@@ -1015,16 +1015,20 @@ class KFAC:
             f"rho{side}": jnp.zeros((), jnp.float32),
         }
 
-    def init(self, params: PyTree) -> KFACState:
-        """Identity factors + zero eigen state (kfac_preconditioner.py:155-165).
+    def _identity_factors(
+        self, params: PyTree
+    ) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Identity-initialized factor dict for ``params`` — the shape oracle.
 
-        Identity init followed by the first EMA update reproduces the
-        reference's ``steps == 0`` behavior (``A₀ = decay·I + (1−decay)·a``).
+        Factored out of :meth:`init` so restore-time machinery (the elastic
+        replan path) can derive the per-layer factor shapes — and hence the
+        deterministic owner-shard plan — from params alone, without building
+        eigen state or touching a mesh.
         """
         names, _ = self._layer_meta(params)
         gcounts = capture.group_counts(names)
         scounts = capture.lens_counts(names)
-        facs, eigen = {}, {}
+        facs = {}
         for name in names:
             base, group_idx = capture.split_group_name(name)
             base, split_idx = capture.split_lens_name(base)
@@ -1042,16 +1046,6 @@ class KFAC:
                     "A_diag": jnp.ones((vocab,), jnp.float32),
                     "G": jnp.eye(feats, dtype=jnp.float32),
                 }
-                if self.precond_method == "inverse":
-                    eigen[name] = {
-                        "iA_diag": jnp.zeros((vocab,), jnp.float32),
-                        "iG": jnp.zeros((feats, feats), self.eigen_dtype),
-                    }
-                else:
-                    eigen[name] = {
-                        "dA": jnp.zeros((vocab,), jnp.float32),
-                        **self._eigen_side_init("G", feats),
-                    }
                 continue
             kernel = node["kernel"]
             has_bias = "bias" in node
@@ -1077,6 +1071,40 @@ class KFAC:
                 "A": jnp.eye(a_side, dtype=jnp.float32),
                 "G": jnp.eye(g_side, dtype=jnp.float32),
             }
+        return facs
+
+    def factor_shapes(self, params: PyTree):
+        """``({name: (g, a)}, diag_a_names)`` for ``params`` — the pure
+        inputs of ``parallel.assignment`` planning. Every host derives the
+        same answer from the same params structure, which is what makes the
+        elastic resize replan deterministic."""
+        return self._owner_shapes(self._identity_factors(params))
+
+    def init(self, params: PyTree) -> KFACState:
+        """Identity factors + zero eigen state (kfac_preconditioner.py:155-165).
+
+        Identity init followed by the first EMA update reproduces the
+        reference's ``steps == 0`` behavior (``A₀ = decay·I + (1−decay)·a``).
+        """
+        facs = self._identity_factors(params)
+        eigen = {}
+        for name, f in facs.items():
+            if "A_diag" in f:
+                vocab = int(f["A_diag"].shape[0])
+                feats = int(f["G"].shape[0])
+                if self.precond_method == "inverse":
+                    eigen[name] = {
+                        "iA_diag": jnp.zeros((vocab,), jnp.float32),
+                        "iG": jnp.zeros((feats, feats), self.eigen_dtype),
+                    }
+                else:
+                    eigen[name] = {
+                        "dA": jnp.zeros((vocab,), jnp.float32),
+                        **self._eigen_side_init("G", feats),
+                    }
+                continue
+            a_side = int(f["A"].shape[0])
+            g_side = int(f["G"].shape[0])
             if self.precond_method == "inverse":
                 eigen[name] = {
                     "iA": jnp.zeros((a_side, a_side), self.eigen_dtype),
@@ -1149,7 +1177,7 @@ class KFAC:
                         "cond_A": jnp.zeros((), jnp.float32),
                         "cond_G": jnp.zeros((), jnp.float32),
                     }
-                    for name in names
+                    for name in facs
                 },
             }
         return state

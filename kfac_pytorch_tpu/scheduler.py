@@ -177,6 +177,46 @@ class EigenRefreshCadence:
         self._flush_slip = 0  # steps the owed flush has slipped
         self._since_flush = 0  # capture steps since the last flush (gauge)
 
+    def state_dict(self) -> dict:
+        """JSON-serializable snapshot of the host-side interval state.
+
+        The chunk cadence lives OUTSIDE the device pytree — which chunks of
+        the open refresh interval have landed, whether the bootstrap refresh
+        ran, the staleness slip counters. A mid-interval resume that rebuilt
+        a fresh cadence would re-bootstrap (monolithic refresh) and diverge
+        from the uninterrupted run; elastic snapshots carry this dict in the
+        manifest so ``flags_for_step`` picks up exactly where it stopped.
+        """
+        return {
+            "landed": sorted(self._landed),
+            "plan_key": (
+                None
+                if self._plan_key is None
+                else [int(self._plan_key[0]), bool(self._plan_key[1])]
+            ),
+            "last_refresh_step": self._last_refresh_step,
+            "bootstrapped": self._bootstrapped,
+            "swap_pending": self._swap_pending,
+            "swap_slip": self._swap_slip,
+            "flush_owed": self._flush_owed,
+            "flush_slip": self._flush_slip,
+            "since_flush": self._since_flush,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore :meth:`state_dict` output (elastic resume path)."""
+        self._landed = set(int(c) for c in d.get("landed", []))
+        pk = d.get("plan_key")
+        self._plan_key = None if pk is None else (int(pk[0]), bool(pk[1]))
+        lrs = d.get("last_refresh_step")
+        self._last_refresh_step = None if lrs is None else int(lrs)
+        self._bootstrapped = bool(d.get("bootstrapped", False))
+        self._swap_pending = bool(d.get("swap_pending", False))
+        self._swap_slip = int(d.get("swap_slip", 0))
+        self._flush_owed = bool(d.get("flush_owed", False))
+        self._flush_slip = int(d.get("flush_slip", 0))
+        self._since_flush = int(d.get("since_flush", 0))
+
     def _pressure(self) -> float:
         """The measured comm/compute ratio from the trainer-wired signal;
         0.0 (never slip) when none is wired."""

@@ -26,11 +26,19 @@ def checkpoint_path(checkpoint_dir: str, epoch: int) -> str:
 
 
 def save_checkpoint(checkpoint_dir: str, epoch: int, state: Any) -> str:
-    """Write the full state pytree for ``epoch`` (process 0 only)."""
+    """Write the full state pytree for ``epoch``.
+
+    Routed through the elastic subsystem's sharding-aware writer: on a
+    single host the path is bitwise-identical to the historical process-0
+    ``device_get`` + save; with multiple processes every process hands
+    orbax its live global arrays, so owner-sharded leaves are written by
+    hosts that can actually address them (the old process-0-only
+    ``device_get`` silently dropped other hosts' shards).
+    """
+    from kfac_pytorch_tpu.elastic import state_io
+
     path = checkpoint_path(checkpoint_dir, epoch)
-    if jax.process_index() == 0:
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(path, jax.device_get(state), force=True)
+    state_io.save_pytree(path, state)
     return path
 
 

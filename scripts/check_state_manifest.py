@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Lint: K-FAC state keys touched in code ↔ the elastic snapshot manifest.
+
+Every top-level key any lever reads or writes on the K-FAC state pytree —
+``state["..."]`` / ``new_state["..."]`` / ``kfac_state["..."]`` anywhere in
+``kfac_pytorch_tpu/`` — must appear in
+``elastic.state_io.KFAC_STATE_KEYS``, or a future lever's state silently
+drifts out of checkpoints (it would round-trip through orbax as an
+unknown leaf with no manifest row, and the elastic save path refuses it).
+Conversely every manifest key must be touched somewhere, so the manifest
+cannot accumulate dead rows.
+
+The scan is AST-based (subscripts of those variable names with constant
+string keys), so docstrings and comments cannot produce false positives
+and a non-literal key is simply invisible — which is fine, because the
+state layout policy (preconditioner.py init) only ever uses literals.
+
+Exit 0 clean, 1 with a report otherwise. Run from the repo root (tier-1
+wraps it in a test).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "kfac_pytorch_tpu"
+STATE_VARS = {"state", "new_state", "kfac_state"}
+
+
+def keys_in_file(path: pathlib.Path) -> set:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    found = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id in STATE_VARS):
+            continue
+        key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            found.add(key.value)
+    return found
+
+
+def main() -> int:
+    sys.path.insert(0, str(ROOT))
+    from kfac_pytorch_tpu.elastic.state_io import KFAC_STATE_KEYS
+
+    touched = {}
+    for f in sorted(PKG.rglob("*.py")):
+        for key in keys_in_file(f):
+            touched.setdefault(key, []).append(
+                str(f.relative_to(ROOT))
+            )
+
+    manifest = set(KFAC_STATE_KEYS)
+    missing = sorted(set(touched) - manifest)
+    dead = sorted(manifest - set(touched))
+    ok = True
+    if missing:
+        ok = False
+        print("state keys touched in code but MISSING from the manifest")
+        print("(elastic/state_io.py KFAC_STATE_KEYS):")
+        for k in missing:
+            print(f"  {k!r:24} touched in {', '.join(touched[k])}")
+    if dead:
+        ok = False
+        print("manifest keys no code touches (dead rows):")
+        for k in dead:
+            print(f"  {k!r}")
+    if not ok:
+        return 1
+    print(
+        f"OK: {len(manifest)} manifest keys == "
+        f"{len(touched)} state keys touched across kfac_pytorch_tpu/"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,12 +19,12 @@ from kfac_pytorch_tpu.training import checkpoint as ckpt
 from kfac_pytorch_tpu.training.step import TrainState, make_sgd
 
 
-def _state():
+def _state(**kfac_kw):
     model = cifar_resnet.get_model("resnet20")
     x = jnp.zeros((2, 16, 16, 3))
     vs = model.init(jax.random.PRNGKey(0), x, train=True)
     tx = make_sgd(momentum=0.9, weight_decay=5e-4)
-    kfac = KFAC()
+    kfac = KFAC(**kfac_kw)
     return TrainState(
         step=jnp.asarray(7, jnp.int32),
         params=vs["params"],
@@ -211,6 +211,90 @@ def test_replicated_checkpoint_migrates_to_owner_mode(tmp_path):
     fresh = jax.device_get(k_own.init(restored.params))
     assert (jax.tree_util.tree_structure(own)
             == jax.tree_util.tree_structure(fresh))
+
+
+def test_checkpoint_roundtrip_eigen_swap_slip(tmp_path):
+    """``staleness_budget > 0`` adds the ``eigen_swap_slip`` marker; a
+    nonzero value (a landed pending basis awaiting its slipped swap) must
+    survive the round trip — losing it would swap a stale basis or skip
+    the promotion entirely after resume."""
+    state = _state(eigh_chunks=2, staleness_budget=1)
+    assert "eigen_swap_slip" in state.kfac_state
+    state.kfac_state["eigen_swap_slip"] = jnp.asarray(1, jnp.int32)
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 0, state)
+    restored, _ = ckpt.auto_resume(d, state)
+    assert int(restored.kfac_state["eigen_swap_slip"]) == 1
+    assert "eigen_pending" in restored.kfac_state
+
+
+def test_checkpoint_roundtrip_lens_pseudo_layers(tmp_path):
+    """'#sK' expand-lens pseudo-layer keys (fused QKV splits) must survive
+    the orbax/tensorstore path encoding, like the grouped-conv '#gK' ones."""
+    from kfac_pytorch_tpu import capture
+    from tests.test_lens import B, CIN, S, _FusedQKVNet
+
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(B, CIN).astype(np.float32))
+    m = _FusedQKVNet()
+    vs = m.init(jax.random.PRNGKey(0), x, train=True)
+    kfac = KFAC(layers=capture.discover_layers(m, x, train=True))
+    tx = make_sgd(momentum=0.9, weight_decay=0.0)
+    state = TrainState(
+        step=jnp.asarray(2, jnp.int32),
+        params=vs["params"],
+        batch_stats={},
+        opt_state=tx.init(vs["params"]),
+        kfac_state=kfac.init(vs["params"]),
+    )
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 1, state)
+    restored, _ = ckpt.auto_resume(d, state)
+    facs = restored.kfac_state["factors"]
+    split_names = {f"qkv{capture.SPLIT_SEP}{i}" for i in range(S)}
+    assert split_names | {"head"} <= set(facs)
+    for n in split_names:
+        np.testing.assert_allclose(
+            np.asarray(facs[n]["A"]),
+            np.asarray(state.kfac_state["factors"][n]["A"]),
+            atol=0,
+        )
+
+
+def test_checkpoint_roundtrip_tied_embedding_stats(tmp_path):
+    """Tied-embedding statistics — the SINGLE shared A_diag/G pair both use
+    sites fold into — survive save/restore bitwise after real train steps
+    have moved them off their init values."""
+    from kfac_pytorch_tpu import capture
+    from kfac_pytorch_tpu.training.step import make_train_step
+    from tests.test_lens import VOCAB, _TiedLM
+
+    r = np.random.RandomState(9)
+    ids = jnp.asarray(r.randint(0, VOCAB, size=(16, 6)).astype(np.int32))
+    tgts = (ids * 5 + 2) % VOCAB
+    model = _TiedLM()
+    params = model.init(jax.random.PRNGKey(2), ids, train=True)["params"]
+    kfac = KFAC(damping=0.003,
+                layers=capture.discover_layers(model, ids, train=True))
+    tx = make_sgd(momentum=0.9)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats={}, opt_state=tx.init(params),
+                       kfac_state=kfac.init(params))
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    for i in range(3):
+        state, _ = step(state, (ids, tgts), jnp.float32(0.1),
+                        jnp.float32(0.003), update_factors=True,
+                        update_eigen=i == 0)
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, 0, state)
+    restored, _ = ckpt.auto_resume(d, jax.device_get(state))
+    a = np.asarray(restored.kfac_state["factors"]["emb"]["A_diag"])
+    assert np.abs(a - 1.0).max() > 1e-4, "stats never moved off init"
+    for x_, y_ in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state)),
+        jax.tree_util.tree_leaves(restored),
+    ):
+        np.testing.assert_array_equal(np.asarray(x_), np.asarray(y_))
 
 
 def test_rehome_passthrough_and_refusal():

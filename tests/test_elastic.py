@@ -1,0 +1,399 @@
+"""Elastic runtime (kfac_pytorch_tpu/elastic): preemption, replan, faults.
+
+Pins the subsystem's three guarantees on the 8-device CPU mesh:
+
+* **durability** — a snapshot round-trips the FULL TrainState plus the
+  host-side cadence; the manifest names every state key; damaged snapshots
+  (truncated / corrupt / incomplete) are skipped by scan-resume, never
+  crashed on;
+* **mid-interval exactness** — a snapshot taken while ``eigen_pending`` is
+  half-filled (``eigh_chunks > 1``) and ``factor_sync_age > 0`` resumes
+  BITWISE: the continued run equals the uninterrupted one, in replicated
+  and owner forms alike;
+* **resize** — an owner-form snapshot from an 8-device mesh resumes on a
+  4-device mesh through the deterministic replan (no gather-to-host-0),
+  and after one refresh interval the resized run matches a replicated
+  continuation on the same 4-device mesh at ~1e-6 (the one-stale-interval
+  guarantee, docs/ELASTIC.md).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC, EigenRefreshCadence
+from kfac_pytorch_tpu.elastic import (
+    FaultInjector,
+    FaultSpec,
+    SimulatedPreemption,
+    SnapshotError,
+    Supervisor,
+    faults,
+    replan,
+    state_io,
+)
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+from tests.test_factor_sharding import _MLP, _put, _setup
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _restore_sigterm():
+    """Supervisor tests install a SIGTERM handler; never leak it."""
+    old = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, old)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Gauge assertions need the registry enabled; leave it as found."""
+    tel = get_telemetry()
+    was = tel.enabled
+    tel.enabled = True
+    yield
+    tel.enabled = was
+    tel.reset()
+
+
+def _build(kw, mesh):
+    kfac = KFAC(damping=0.01, fac_update_freq=1, mesh=mesh, **kw)
+    state, fn, batch = _setup(_MLP(), kfac, mesh)
+    state, b = _put(state, batch, mesh, kfac)
+    return kfac, state, fn, b
+
+
+def _run_steps(fn, cad, state, b, lo, hi):
+    for i in range(lo, hi):
+        fl = cad.flags_for_step(i)
+        state, _ = fn(state, b, jnp.float32(0.05), jnp.float32(0.01), **fl)
+    return state
+
+
+def _assert_bitwise(a, b):
+    la = jax.tree_util.tree_leaves(jax.device_get(a))
+    lb = jax.tree_util.tree_leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _tiny_state(step=0):
+    """A minimal manifest-conformant state for pure-I/O tests."""
+    return {
+        "step": jnp.asarray(step, jnp.int32),
+        "factors": {"fc": {"A": jnp.eye(3), "G": jnp.eye(2)}},
+        "eigen": {},
+    }
+
+
+# -------------------------------------------------------------- snapshots
+
+
+def test_snapshot_roundtrip_and_manifest(tmp_path):
+    mesh = data_parallel_mesh()
+    kfac, state, fn, b = _build(dict(kfac_update_freq=2), mesh)
+    cad = EigenRefreshCadence(kfac)
+    state = _run_steps(fn, cad, state, b, 0, 3)
+    sup = Supervisor(str(tmp_path), kfac=kfac, cadence=cad)
+    snap = sup.snapshot(3, state, sync=True)
+
+    manifest = state_io.load_manifest(snap)
+    assert manifest["format"] == "kfac-elastic-snapshot"
+    assert manifest["version"] == state_io.MANIFEST_VERSION
+    assert manifest["step"] == 3
+    assert manifest["sharding"] == "replicated"
+    assert manifest["world"] == 8
+    assert set(manifest["kfac_state_keys"]) <= set(state_io.KFAC_STATE_KEYS)
+    assert manifest["cadence"] is not None
+    assert get_telemetry().gauges.get("kfac/snapshot_duration_ms") is not None
+
+    restored, _ = state_io.restore_snapshot(
+        snap, jax.device_get(state), kfac=kfac
+    )
+    _assert_bitwise(state, restored)
+
+
+def test_manifest_refuses_unknown_state_key():
+    bad = _tiny_state()
+    bad["mystery_lever"] = jnp.zeros(())
+    with pytest.raises(SnapshotError, match="mystery_lever"):
+        state_io.build_manifest(bad)
+
+
+def test_scan_skips_damaged_snapshots(tmp_path):
+    d = str(tmp_path)
+    for s in (2, 4, 6, 8):
+        state_io.save_snapshot(d, s, _tiny_state(s))
+    assert [s for s, _ in state_io.list_snapshots(d)] == [2, 4, 6, 8]
+
+    faults.truncate_snapshot(state_io.snapshot_dir(d, 8))   # mid-write kill
+    faults.corrupt_snapshot(state_io.snapshot_dir(d, 6))    # bitrot
+    faults.mark_incomplete(state_io.snapshot_dir(d, 4))     # torn commit
+    step, snap = state_io.latest_snapshot(d)
+    assert step == 2
+    with pytest.raises(SnapshotError):
+        state_io.load_manifest(state_io.snapshot_dir(d, 6))
+
+    faults.truncate_snapshot(snap)
+    assert state_io.latest_snapshot(d) is None
+
+
+def test_supervisor_gc_keeps_newest(tmp_path):
+    sup = Supervisor(str(tmp_path), snapshot_every=1, keep=2)
+    for s in (1, 2, 3, 4):
+        sup.on_step(s, lambda s=s: _tiny_state(s))
+    sup.wait()
+    assert [s for s, _ in state_io.list_snapshots(str(tmp_path))] == [3, 4]
+
+
+# ------------------------------------------------- preemption & liveness
+
+
+def test_supervisor_sigterm_takes_emergency_snapshot(tmp_path):
+    sup = Supervisor(str(tmp_path), heartbeat_every=1)
+    sup.install_signal_handlers()
+    assert sup.on_step(1, lambda: _tiny_state(1)) is False
+    os.kill(os.getpid(), signal.SIGTERM)  # delivered synchronously
+    assert sup.preempt_requested
+    assert sup.on_step(2, lambda: _tiny_state(2)) is True
+    step, _ = state_io.latest_snapshot(str(tmp_path))
+    assert step == 2
+    assert sup.liveness() == 1  # this host beat within the window
+
+
+def test_fault_injector_raise_and_exit_spec():
+    inj = FaultInjector(FaultSpec(kill_at_step=3, kill_mode="raise"))
+    inj.on_step(2)
+    with pytest.raises(SimulatedPreemption):
+        inj.on_step(3)
+    inj.on_step(4)  # idempotent once fired
+
+    spec = FaultSpec.from_env({
+        "KFAC_FAULT_KILL_AT_STEP": "5", "KFAC_FAULT_KILL_MODE": "exit",
+    })
+    assert spec.kill_at_step == 5 and spec.kill_mode == "exit"
+    assert spec.exit_code == faults.DEFAULT_EXIT_CODE
+    assert FaultSpec.from_env({}) is None
+    with pytest.raises(ValueError):
+        FaultSpec(kill_at_step=1, kill_mode="meteor")
+
+
+def test_fault_injector_signal_mode_through_supervisor(tmp_path):
+    """Signal-mode kill at step k: the SAME on_step call observes the
+    preemption and lands the emergency snapshot at step k."""
+    inj = FaultInjector(FaultSpec(kill_at_step=3, kill_mode="signal"))
+    sup = Supervisor(str(tmp_path), fault_injector=inj)
+    sup.install_signal_handlers()
+    assert sup.on_step(2, lambda: _tiny_state(2)) is False
+    assert sup.on_step(3, lambda: _tiny_state(3)) is True
+    step, _ = state_io.latest_snapshot(str(tmp_path))
+    assert step == 3
+
+
+def test_drop_hosts():
+    devs = list(range(8))
+    assert faults.drop_hosts(devs, 0, 4) == [4, 5, 6, 7]
+    assert faults.drop_hosts(devs, 1, 2) == [0, 1, 4, 5, 6, 7]
+    with pytest.raises(ValueError):
+        faults.drop_hosts(devs, 2, 4)
+
+
+# ------------------------------------------------- mid-interval exactness
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        pytest.param({}, id="replicated"),
+        pytest.param(
+            {"factor_sharding": "owner", "factor_comm_freq": 3}, id="owner"
+        ),
+    ],
+)
+def test_mid_interval_resume_bitwise(tmp_path, extra):
+    """Snapshot at step 6 of a kfac_update_freq=4 / eigh_chunks=3 run:
+    chunks 0 and 1 of the pending refresh have landed (half-filled double
+    buffer) and — owner form — the deferred factor accumulator is one
+    capture past its last flush (factor_sync_age == 1). The resumed run
+    must finish bitwise-equal to the uninterrupted one."""
+    mesh = data_parallel_mesh()
+    kw = dict(kfac_update_freq=4, eigh_chunks=3, **extra)
+    kfac, state, fn, b = _build(kw, mesh)
+    cad = EigenRefreshCadence(kfac)
+
+    state = _run_steps(fn, cad, state, b, 0, 6)
+    # the mid-interval preconditions the snapshot must survive
+    assert cad.state_dict()["landed"] == [0, 1]
+    if "factor_sharding" in extra:
+        assert int(jax.device_get(state.kfac_state["factor_sync_age"])) == 1
+    sup = Supervisor(str(tmp_path), kfac=kfac, cadence=cad)
+    sup.snapshot(6, state, sync=True)
+
+    # uninterrupted: straight through to 12 (covers the chunk-2 landing,
+    # the swap, and the next interval's first chunk)
+    final = _run_steps(fn, cad, state, b, 6, 12)
+
+    # resumed: a fresh process-equivalent — new KFAC, cadence, step fn
+    kfac2, state2, fn2, b2 = _build(kw, mesh)
+    cad2 = EigenRefreshCadence(kfac2)
+    sup2 = Supervisor(str(tmp_path), kfac=kfac2, cadence=cad2)
+    hit = sup2.scan_resume(jax.device_get(state2), params=state2.params)
+    assert hit is not None
+    rstate, manifest, rstep = hit
+    assert rstep == 6
+    assert cad2.state_dict()["landed"] == [0, 1]
+    kstate = rstate.kfac_state
+    rstate = jax.device_put(
+        rstate.replace(kfac_state=None), NamedSharding(mesh, P())
+    )
+    rstate = rstate.replace(kfac_state=kstate)
+    rfinal = _run_steps(fn2, cad2, rstate, b2, 6, 12)
+
+    _assert_bitwise(final, rfinal)
+
+
+# ------------------------------------------------------------ mesh resize
+
+
+def test_mesh_resize_replan_8_to_4(tmp_path):
+    """Owner-form snapshot from the 8-device mesh, resumed on a 4-device
+    mesh carved by drop-host: the replan re-derives both LPT plans
+    deterministically (bitwise-repeatable), carries the factor EMAs and
+    active bases over, and after one refresh interval the resized run
+    matches a replicated continuation on the SAME 4-device mesh at ~1e-6
+    — the one-stale-interval guarantee."""
+    mesh8 = data_parallel_mesh()
+    kw = dict(kfac_update_freq=2)
+    k8, s8, f8, b8 = _build({**kw, "factor_sharding": "owner"}, mesh8)
+    cad8 = EigenRefreshCadence(k8)
+    s8 = _run_steps(f8, cad8, s8, b8, 0, 4)
+    sup8 = Supervisor(str(tmp_path), kfac=k8, cadence=cad8)
+    sup8.snapshot(4, s8, sync=True)
+
+    # the replicated twin of the same trajectory (owner == replicated at
+    # ~1e-6, tests/test_factor_sharding.py) — the "fresh mesh" oracle
+    kr8, sr8, fr8, br8 = _build(kw, mesh8)
+    cadr = EigenRefreshCadence(kr8)
+    sr8 = _run_steps(fr8, cadr, sr8, br8, 0, 4)
+
+    # survivors after losing simulated host 1 (devices 4..7): a 4-wide mesh
+    mesh4 = Mesh(
+        np.asarray(faults.drop_hosts(list(mesh8.devices.flat), 1, 4)),
+        ("data",),
+    )
+    assert mesh4.devices.size == 4
+
+    k4, s4t, f4, b4 = _build({**kw, "factor_sharding": "owner"}, mesh4)
+    cad4 = EigenRefreshCadence(k4)
+    sup4 = Supervisor(str(tmp_path), kfac=k4, cadence=cad4)
+    hit = sup4.scan_resume(jax.device_get(s4t), params=s4t.params)
+    assert hit is not None
+    r4, manifest, rstep = hit
+    assert rstep == 4 and manifest["world"] == 8
+    assert get_telemetry().gauges.get("kfac/replan_count", 0) >= 1
+
+    # determinism: replanning the same host state twice is bitwise-equal
+    host_k = jax.device_get(state_io.kfac_state_of(r4))  # already 4-world
+
+    def resize_again():
+        old = state_io.restore_snapshot(
+            state_io.snapshot_dir(str(tmp_path), 4), jax.device_get(s4t)
+        )[0]
+        return replan.resize_owner_state(
+            k4, old.kfac_state, s4t.params, old_world=8,
+            expect_fingerprint=manifest["shard_plan_fingerprint"],
+        )
+
+    _assert_bitwise(resize_again(), resize_again())
+    _assert_bitwise(host_k, resize_again())
+
+    # a wrong fingerprint is refused, not silently remapped
+    with pytest.raises(ValueError, match="fingerprint"):
+        old = state_io.restore_snapshot(
+            state_io.snapshot_dir(str(tmp_path), 4), jax.device_get(s4t)
+        )[0]
+        replan.resize_owner_state(
+            k4, old.kfac_state, s4t.params, old_world=8,
+            expect_fingerprint="0badc0ffee0badc0",
+        )
+
+    # continue BOTH runs on the 4-device mesh through one full refresh
+    # interval (refresh at step 4, next at 6)
+    kstate = r4.kfac_state
+    r4 = jax.device_put(
+        r4.replace(kfac_state=None), NamedSharding(mesh4, P())
+    )
+    r4 = r4.replace(kfac_state=kstate)
+    r4 = _run_steps(f4, cad4, r4, b4, 4, 8)
+
+    kr4, _, frep4, brep4 = _build(kw, mesh4)
+    sr4 = jax.device_put(
+        jax.device_get(sr8), NamedSharding(mesh4, P())
+    )
+    sr4 = _run_steps(frep4, cadr, sr4, brep4, 4, 8)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(r4.params)),
+        jax.tree_util.tree_leaves(jax.device_get(sr4.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+
+
+# -------------------------------------------------------- examples smoke
+
+
+def test_examples_cli_fault_kill_and_resume(tmp_path):
+    """The wikitext trainer, killed hard at step 3 by the env fault
+    injector (exit 75, a pod eviction), resumes from its step-2 periodic
+    snapshot on rerun — losing ≤ one refresh interval — and the loss
+    keeps training."""
+    save_dir = str(tmp_path / "snaps")
+    args = [
+        sys.executable,
+        os.path.join(REPO, "examples", "train_wikitext_rnn.py"),
+        "--synthetic", "--epochs", "1", "--steps-per-epoch", "6",
+        "--emsize", "32", "--nhid", "32", "--nlayers", "1",
+        "--batch-size", "8", "--bptt", "16", "--kfac-update-freq", "2",
+        "--preempt-save-dir", save_dir, "--snapshot-every", "2",
+    ]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        KFAC_FAULT_KILL_AT_STEP="3",
+        KFAC_FAULT_KILL_MODE="exit",
+    )
+    res = subprocess.run(
+        args, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert res.returncode == faults.DEFAULT_EXIT_CODE, (
+        f"rc={res.returncode}\n{res.stderr[-2000:]}"
+    )
+    assert "hard-killing at step 3" in res.stderr
+    step, _ = state_io.latest_snapshot(save_dir)
+    assert step == 2  # kill at 3 loses exactly one step < refresh interval
+
+    env.pop("KFAC_FAULT_KILL_AT_STEP")
+    env.pop("KFAC_FAULT_KILL_MODE")
+    res = subprocess.run(
+        args, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, f"rc={res.returncode}\n{res.stderr[-2000:]}"
+    assert "elastic: resumed from snapshot at step 2" in res.stdout
+    # the resumed epoch trained and produced a finite loss
+    line = next(l for l in res.stdout.splitlines() if l.startswith("epoch 0"))
+    loss = float(line.split("loss=")[1].split()[0])
+    assert np.isfinite(loss)

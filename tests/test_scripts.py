@@ -127,6 +127,19 @@ def test_plan_snapshot_check():
     assert "OK" in res.stdout
 
 
+def test_state_manifest_check():
+    """Every K-FAC state key any lever touches must appear in the elastic
+    snapshot manifest (elastic/state_io.py KFAC_STATE_KEYS), and every
+    manifest row must be touched by code — a future lever can't silently
+    drift its state out of checkpoints (scripts/check_state_manifest.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_state_manifest.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_no_bytecode_artifacts_tracked():
     """git must never track __pycache__ directories or .pyc files — stale
     bytecode shadows source edits and bloats the repo."""
