@@ -89,6 +89,16 @@ def _emit(error: str | None = None, partial: bool = False) -> None:
                 best = _best_overhead()
                 prod = _ARMS.get("production") or {}
                 over = _ARMS.get("overlap") or {}
+                strm = _ARMS.get("stream") or {}
+                headline = over.get(
+                    "overhead_pct", prod.get("overhead_pct", best))
+                # the streaming arm takes the headline when its drift-gated
+                # schedule measured AND wins — the solver is a strict
+                # operating-point improvement, not a numerics trade
+                if strm.get("overhead_pct") is not None and (
+                    headline is None or strm["overhead_pct"] < headline
+                ):
+                    headline = strm["overhead_pct"]
                 rec = {
                     "metric": METRIC,
                     "value": best,
@@ -99,9 +109,9 @@ def _emit(error: str | None = None, partial: bool = False) -> None:
                     # measured (its real operating point — fused comm +
                     # hidden refresh), else the plain production profile,
                     # else the best single-lever arm (so partial runs still
-                    # track something comparable)
-                    "headline_overhead_vs_sgd": over.get(
-                        "overhead_pct", prod.get("overhead_pct", best)),
+                    # track something comparable); the -stream arm overrides
+                    # any of them when its measured schedule wins
+                    "headline_overhead_vs_sgd": headline,
                     "detail": {
                         **_META,
                         "timing": "pipelined (dispatch N, block once), "
@@ -648,7 +658,8 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         factor_state_bytes_local=int(factor_state_bytes_local),
         solver=getattr(kfac, "solver", "eigh"),
         solver_rank=(
-            kfac.solver_rank if getattr(kfac, "solver", "eigh") == "rsvd"
+            kfac.solver_rank
+            if getattr(kfac, "solver", "eigh") in ("rsvd", "streaming")
             else None
         ),
         eigen_table_bytes=int(eigen_table_bytes),
@@ -662,6 +673,64 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         staleness_budget=int(getattr(kfac, "staleness_budget", 0)),
         staleness_p95=_staleness_p95(kfac, kfac_freq),
     )
+
+    if getattr(kfac, "solver", "eigh") == "streaming":
+        # Streaming cadence window: unlike the host-only staleness replay,
+        # re-orth counting needs REAL steps — the drift signal reads the
+        # device-side residual gauge the folds produce. A short window (the
+        # bootstrap re-orth plus fold steps) measures the residual
+        # trajectory and the observed re-orth rate; every program it runs
+        # was already compiled by the timing loops above.
+        from kfac_pytorch_tpu.scheduler import EigenRefreshCadence
+
+        box = {"s": s_kfac}
+        kfac.stream_drift_signal = lambda: float(
+            jax.device_get(box["s"].kfac_state["stream_residual"]))
+        cad = EigenRefreshCadence(kfac)
+        n_sim = int(min(2 * max(1, int(kfac_freq)), 24))
+        residuals = []
+        for step in range(n_sim):
+            fl = cad.flags_for_step(step)
+            s, _ = kfac_step(box["s"], (images, labels), lr, damping, **fl)
+            box["s"] = s
+            residuals.append(float(
+                jax.device_get(s.kfac_state["stream_residual"])))
+        s_kfac = box["s"]
+        kfac.stream_drift_signal = None
+        reorth = int(cad._reorth_count)
+        rec.update(
+            reorth_count=reorth,
+            stream_sim_steps=n_sim,
+            residual_mass_p95=round(
+                float(np.percentile(residuals, 95)), 5),
+            stream_drift_threshold=float(kfac.stream_drift_threshold),
+        )
+        # re-amortize with the observed re-orth rate: fold steps cost
+        # t_fac (capture + fold — the +factors program IS the fold program
+        # for this solver), re-orths cost t_full at the measured frequency
+        eigen_rate = reorth / float(n_sim)
+        t_stream = (
+            t_plain
+            + (t_fac - t_plain) / float(fac_freq)
+            + (t_full - t_fac) * eigen_rate
+        )
+        stream_overhead = (t_stream - t_sgd) / t_sgd * 100.0
+        print(
+            f"kfac{tag} streaming: {reorth} re-orth(s) in {n_sim} steps, "
+            f"residual p95 {rec['residual_mass_p95']}; amortized "
+            f"{t_stream*1e3:.2f} ms → overhead {stream_overhead:.1f}%",
+            file=sys.stderr,
+        )
+        rec.update(
+            kfac_stream_amortized_ms=round(t_stream * 1e3, 3),
+            overhead_stream_pct=round(stream_overhead, 2),
+        )
+        # the drift-gated schedule is this arm's real operating point — let
+        # the headline pick it up when it beats the periodic amortization
+        if t_stream < t_amort:
+            rec.update(kfac_amortized_ms=round(t_stream * 1e3, 3),
+                       kfac_img_per_s_chip=round(batch / t_stream, 1),
+                       overhead_pct=round(stream_overhead, 2))
 
     # read the RESOLVED lever off the preconditioner, not the kwargs — a
     # profile arm's plan can engage the chunked refresh without the arm
@@ -1083,10 +1152,12 @@ def main():
         # flush/swap slip one step. Read refresh p95 (pipe_step_time_ms)
         # against steady p50 for the hiding headline; its overhead_pct takes
         # over headline_overhead_vs_sgd when it measures (docs/PERF.md
-        # "Compute/communication overlap").
+        # "Compute/communication overlap"). solver="rsvd" is pinned: the
+        # production profile resolves solver="streaming" at scale, which
+        # refuses the chunk/slip levers this arm exists to measure.
         ("overlap", "-overlap", batch, None,
          dict(profile="production", comm_overlap=True, staleness_budget=1,
-              eigh_chunks=4), True),
+              eigh_chunks=4, solver="rsvd"), True),
         # -pipe: the chunked/double-buffered refresh (KFAC(eigh_chunks=4)) at
         # reference-parity numerics — measures the per-chunk step programs on
         # top of the standard three and reports pipe_step_time_ms (p50/p95/
@@ -1124,6 +1195,20 @@ def main():
         # (dense eigh, square Q tables) at identical numerics elsewhere
         ("rsvd", "-rsvd", batch, None,
          dict(solver="rsvd", solver_rank=128, solver_auto_threshold=512),
+         True),
+        # -stream: streaming low-rank curvature — same truncated layout as
+        # -rsvd but capture steps FOLD statistics through the retained bases
+        # (matmul-only; scripts/check_solver_hlo.py pins zero eighs) and the
+        # re-orthonormalization is drift-gated instead of periodic. Reports
+        # reorth_count / residual_mass_p95 from a short real-step cadence
+        # window; overhead_stream_pct re-amortizes with the observed re-orth
+        # rate and takes over overhead_pct when it wins, at which point the
+        # headline prefers this arm. (The production profile engages
+        # streaming on its own at scale — the -prod arm is the composed
+        # form; this arm isolates the solver lever against -rsvd/f32.)
+        ("stream", "-stream", batch, None,
+         dict(solver="streaming", solver_rank=128, solver_auto_threshold=512,
+              stream_drift_threshold=0.05),
          True),
         ("aggressive", "-aggr", batch, None,
          dict(precond_precision=lax.Precision.DEFAULT,

@@ -211,11 +211,14 @@ def parse_args(argv=None):
                    help="log per-epoch K-FAC stability telemetry (KL-clip "
                         "coefficient nu min/mean, min damped eigenvalue) to "
                         "--log-dir")
-    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+    p.add_argument("--solver", default="eigh",
+                   choices=["eigh", "rsvd", "streaming"],
                    help="curvature eigensolver: eigh = full (dense) "
                         "eigendecomposition, rsvd = randomized truncated "
                         "eigensolve + low-rank Woodbury apply for factor "
-                        "sides >= --solver-auto-threshold (docs/PERF.md)")
+                        "sides >= --solver-auto-threshold, streaming = rsvd "
+                        "layout with per-step matmul-only folds and "
+                        "drift-gated re-orthonormalization (docs/PERF.md)")
     p.add_argument("--solver-rank", type=int, default=128,
                    help="eigenpairs kept per truncated factor side "
                         "(--solver rsvd); watch kfac/spectrum_mass_captured "
@@ -223,6 +226,11 @@ def parse_args(argv=None):
     p.add_argument("--solver-auto-threshold", type=int, default=512,
                    help="factor sides at least this large use the truncated "
                         "solver; smaller sides stay dense (--solver rsvd)")
+    p.add_argument("--stream-drift-threshold", type=float, default=0.05,
+                   help="--solver streaming: re-orthonormalize at a refresh "
+                        "boundary only when the residual-mass drift gauge "
+                        "(kfac/stream_residual_mass) exceeds this; 0 = "
+                        "re-orth every boundary, exactly periodic rsvd")
     p.add_argument("--comm-overlap", action="store_true",
                    help="fuse the factor-statistics reduction into the "
                         "gradient stream: the bucketed factor psums issue "
@@ -347,6 +355,7 @@ def main(argv=None):
                 solver=args.solver,
                 solver_rank=args.solver_rank,
                 solver_auto_threshold=args.solver_auto_threshold,
+                stream_drift_threshold=args.stream_drift_threshold,
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
@@ -569,6 +578,12 @@ def main(argv=None):
     # host-side refresh cadence: identical to kfac_flags_for_step at
     # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
     cadence = EigenRefreshCadence(kfac)
+    if kfac is not None and getattr(kfac, "solver", "eigh") == "streaming":
+        # drift signal for the cadence's boundary decisions: one scalar
+        # device_get per kfac_update_freq boundary (not per step), read off
+        # the LIVE state — the lambda closes over the rebinding variable
+        kfac.stream_drift_signal = lambda: float(
+            jax.device_get(state.kfac_state["stream_residual"]))
 
     sup = None
     resume_skip = 0

@@ -159,7 +159,8 @@ def parse_args(argv=None):
                         "(--seq-parallel 1; --tensor-parallel composes). "
                         "Diagonal-A embedding factors shard as [vocab] "
                         "vector slots, so --kfac-embedding composes too")
-    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+    p.add_argument("--solver", default="eigh",
+                   choices=["eigh", "rsvd", "streaming"],
                    help="curvature eigensolver: eigh = full (dense) "
                         "eigendecomposition, rsvd = randomized truncated "
                         "eigensolve + low-rank Woodbury apply for factor "
@@ -171,6 +172,10 @@ def parse_args(argv=None):
     p.add_argument("--solver-auto-threshold", type=int, default=512,
                    help="factor sides at least this large use the truncated "
                         "solver; smaller sides stay dense (--solver rsvd)")
+    p.add_argument("--stream-drift-threshold", type=float, default=0.05,
+                   help="--solver streaming: re-orthonormalize at a refresh "
+                        "boundary only when the residual-mass drift gauge "
+                        "exceeds this (0 = every boundary, periodic rsvd)")
     p.add_argument("--comm-overlap", action="store_true",
                    help="fuse the factor-statistics reduction into the "
                         "gradient stream: the bucketed factor psums issue "
@@ -253,6 +258,7 @@ def main(argv=None):
         solver=args.solver,
         solver_rank=args.solver_rank,
         solver_auto_threshold=args.solver_auto_threshold,
+        stream_drift_threshold=args.stream_drift_threshold,
         factor_sharding=args.factor_sharding,
         comm_overlap=args.comm_overlap,
         staleness_budget=args.staleness_budget,
@@ -372,6 +378,7 @@ def main(argv=None):
                 solver=args.solver,
                 solver_rank=args.solver_rank,
                 solver_auto_threshold=args.solver_auto_threshold,
+                stream_drift_threshold=args.stream_drift_threshold,
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
@@ -515,6 +522,11 @@ def main(argv=None):
     # host-side refresh cadence: identical to kfac_flags_for_step at
     # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
     cadence = EigenRefreshCadence(kfac)
+    if kfac is not None and getattr(kfac, "solver", "eigh") == "streaming":
+        # drift signal for boundary decisions: one scalar device_get per
+        # kfac_update_freq boundary, read off the LIVE state
+        kfac.stream_drift_signal = lambda: float(
+            jax.device_get(state.kfac_state["stream_residual"]))
 
     sup = None
     resume_skip = 0

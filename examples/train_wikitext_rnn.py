@@ -118,11 +118,18 @@ def parse_args(argv=None):
                         "O(model/devices) factor memory; embedding diag-A "
                         "factors shard as [vocab] vector slots, so "
                         "--kfac-embedding composes (docs/PERF.md)")
-    p.add_argument("--solver", default="eigh", choices=["eigh", "rsvd"],
+    p.add_argument("--solver", default="eigh",
+                   choices=["eigh", "rsvd", "streaming"],
                    help="curvature eigensolver (rsvd: randomized truncated "
-                        "refresh + Woodbury apply for big factor sides)")
+                        "refresh + Woodbury apply for big factor sides; "
+                        "streaming: rsvd layout, per-step folds, drift-gated "
+                        "re-orthonormalization)")
     p.add_argument("--solver-rank", type=int, default=128)
     p.add_argument("--solver-auto-threshold", type=int, default=512)
+    p.add_argument("--stream-drift-threshold", type=float, default=0.05,
+                   help="--solver streaming: re-orth at a boundary only when "
+                        "the residual-mass gauge exceeds this (0 = every "
+                        "boundary, periodic rsvd)")
     p.add_argument("--comm-overlap", action="store_true",
                    help="fuse the factor-statistics reduction into the "
                         "gradient stream (multi-device only; bitwise-"
@@ -195,6 +202,7 @@ def main(argv=None):
                 solver=args.solver,
                 solver_rank=args.solver_rank,
                 solver_auto_threshold=args.solver_auto_threshold,
+                stream_drift_threshold=args.stream_drift_threshold,
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
@@ -234,6 +242,7 @@ def main(argv=None):
                 solver=args.solver,
                 solver_rank=args.solver_rank,
                 solver_auto_threshold=args.solver_auto_threshold,
+                stream_drift_threshold=args.stream_drift_threshold,
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
@@ -312,6 +321,11 @@ def main(argv=None):
     # host-side refresh cadence: identical to kfac_flags_for_step at
     # --eigh-chunks 1, chunk/swap flags beyond (scheduler.EigenRefreshCadence)
     cadence = EigenRefreshCadence(kfac)
+    if kfac is not None and getattr(kfac, "solver", "eigh") == "streaming":
+        # drift signal for boundary decisions: one scalar device_get per
+        # kfac_update_freq boundary, read off the LIVE state
+        kfac.stream_drift_signal = lambda: float(
+            jax.device_get(state.kfac_state["stream_residual"]))
     max_steps = (train_stream.shape[1] - 1) // args.bptt
     steps_per_epoch = min(args.steps_per_epoch or max_steps, max_steps)
 

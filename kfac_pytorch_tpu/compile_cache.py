@@ -74,11 +74,17 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
     warm-started cadence for the resume-from-checkpoint case where the
     monolithic bootstrap refresh compiles in its post-warmup form.
 
-    The curvature solver choice (``solver="rsvd"`` vs ``"eigh"``) does
-    NOT change the count: the rank policy is a pure function of static
-    factor shapes, so it swaps WHICH programs compile (truncated vs
-    dense refresh, Woodbury vs dense apply), never how many the schedule
-    produces.
+    The ``solver="rsvd"`` vs ``"eigh"`` choice does NOT change the count:
+    the rank policy is a pure function of static factor shapes, so it
+    swaps WHICH programs compile (truncated vs dense refresh, Woodbury
+    vs dense apply), never how many the schedule produces.
+    ``solver="streaming"`` CAN change it: the replay drives the cadence
+    with no drift signal (re-orth at every boundary), and a run with a
+    wired signal may additionally skip boundary re-orths — so every
+    ``update_eigen`` variant is budgeted alongside its eigen-off twin
+    (the fold-instead-of-re-orth program). Since streaming refuses
+    chunks and swap-slip, the total still shrinks relative to a chunked
+    schedule.
     """
     if kfac is None:
         return 1 + 2 * int(autotune_candidates)
@@ -105,6 +111,10 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
             solver_rank=plan.solver_rank,
             staleness_budget=int(getattr(plan, "staleness_budget", 0)),
             staleness_signal=None,
+            stream_drift_threshold=float(
+                getattr(plan, "stream_drift_threshold", 0.05)
+            ),
+            stream_drift_signal=None,
         )
 
     hp = sim.hparams
@@ -181,6 +191,22 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
             ):
                 twin = dict(flags)
                 twin["swap_eigen"] = True
+                extra.add(tuple(sorted(twin.items())))
+        variants |= extra
+
+    # Streaming skipped-re-orth twins. The no-signal replay above
+    # re-orthonormalizes at every boundary; a run with a wired drift
+    # signal may instead skip a boundary — same step schedule, same
+    # (forced) flush, but update_eigen off: the fold-only program. Budget
+    # an eigen-off twin for every eigen-on variant so a quiet drift gauge
+    # never reads as a retrace.
+    if getattr(sim, "solver", "eigh") == "streaming":
+        extra = set()
+        for key in variants:
+            flags = dict(key)
+            if flags.get("update_eigen"):
+                twin = dict(flags)
+                twin["update_eigen"] = False
                 extra.add(tuple(sorted(twin.items())))
         variants |= extra
 

@@ -66,7 +66,12 @@ KFAC_STATE_KEYS: Dict[str, str] = {
     "factor_sync_age": "capture steps since the last cross-replica factor "
                        "merge (int32 scalar, 0 = globally synced)",
     "spectrum_mass": "trace fraction the truncated bases captured at the "
-                     "last refresh (solver='rsvd')",
+                     "last refresh (solver='rsvd'/'streaming')",
+    "stream_residual": "drift gauge: curvature mass fraction outside the "
+                       "retained bases after the last fold "
+                       "(solver='streaming', f32 scalar)",
+    "stream_fold_steps": "capture folds since the last re-orthonormalization "
+                         "(solver='streaming', int32 scalar)",
     "eigen_swap_slip": "1 while a fully-landed pending basis awaits its "
                        "slipped swap (staleness_budget > 0)",
     "diagnostics": "in-graph health diagnostics (track_diagnostics=True)",

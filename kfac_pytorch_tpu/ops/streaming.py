@@ -1,0 +1,139 @@
+"""Streaming low-rank curvature maintenance: the per-step fold kernels.
+
+``KFAC(solver="streaming")`` keeps the truncated eigenbasis ``Q`` from the
+last re-orthonormalization fixed and, on every capture step, *folds* the
+freshly EMA'd factor back through it: ``d = diag(Qᵀ F Q)`` (a Rayleigh
+quotient per retained direction) and ``rho = (tr F − Σ d) / (n − r)`` (the
+out-of-basis mass spread over the residual subspace, exactly the
+:func:`kfac_pytorch_tpu.ops.rsvd.residual_rho` convention). The fold is a
+pure function of ``(Q, F)`` — no incremental error accumulates between
+re-orths, deferred-mode flushes can fold the *merged* factor and land on
+the same state as per-step folding would at that factor, and the compiled
+step contains only matmuls (``scripts/check_solver_hlo.py`` pins zero eigh
+custom-calls in the streaming capture program).
+
+Re-orthonormalization itself is NOT here: when the drift gauge trips,
+``EigenRefreshCadence`` simply schedules a normal ``update_eigen`` step and
+the existing rsvd refresh (``ops/rsvd.py`` tall-sketch + rank-(r+p)
+Rayleigh–Ritz) rebuilds the basis — streaming at
+``stream_drift_threshold=0`` with ``kfac_update_freq=1`` is therefore
+bit-identical to periodic ``solver="rsvd"``.
+
+The drift gauge returned by :func:`fold_replicated` is
+``Σ (tr F − Σ d)₊ / Σ tr F`` over the truncated sides only — the fraction
+of curvature mass the retained bases no longer explain. It is 0 when no
+side is truncated (everything dense ⇒ nothing can drift out of basis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .eigh import symmetrize
+from .precondition import shape_groups
+
+_PRECISION = lax.Precision.HIGHEST
+
+
+def fold_diag(d: jnp.ndarray, fac_diag: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Diagonal-A (embedding) side: the 'basis' is the coordinate basis, so
+    the fold is just the eps-floor the refresh path applies."""
+    f = fac_diag.astype(jnp.float32)
+    return f * (f > eps)
+
+
+def fold_side(
+    q: jnp.ndarray, fac: jnp.ndarray, eps: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold one factor (stack) through its retained basis (stack).
+
+    ``q``: ``[..., n, r]`` basis (any dtype — cast up for the contraction),
+    ``fac``: ``[..., n, n]`` EMA'd factor. Returns ``(d, trace)`` with
+    ``d``: ``[..., r]`` eps-floored Rayleigh diagonals (f32) and ``trace``:
+    ``[...]`` factor traces (f32, for the rho/residual bookkeeping). Two
+    thin matmuls — no eigendecomposition.
+    """
+    qf = q.astype(jnp.float32)
+    ff = symmetrize(fac.astype(jnp.float32))
+    t = jnp.einsum("...ij,...jr->...ir", ff, qf, precision=_PRECISION)
+    d = jnp.einsum("...ir,...ir->...r", t, qf, precision=_PRECISION)
+    d = d * (d > eps)
+    trace = jnp.trace(ff, axis1=-2, axis2=-1)
+    return d, trace
+
+
+def fold_rho(
+    trace: jnp.ndarray, d: jnp.ndarray, n: int, rank: int
+) -> jnp.ndarray:
+    """Residual eigenvalue after a fold — same convention as
+    :func:`kfac_pytorch_tpu.ops.rsvd.residual_rho` (clipped at 0, denominator
+    floored at 1)."""
+    leftover = trace - jnp.sum(d, axis=-1)
+    return jnp.maximum(leftover, 0.0) / float(max(n - rank, 1))
+
+
+def fold_replicated(
+    facs: Dict[str, Dict[str, jnp.ndarray]],
+    singles: Dict[str, Dict[str, jnp.ndarray]],
+    stacked: Dict[str, Dict[str, jnp.ndarray]],
+    eps: float,
+) -> Tuple[Dict, Dict, jnp.ndarray]:
+    """Fold every layer's factors through the current bases (replicated form).
+
+    Operates directly on the split eigen layout (``singles`` per-layer
+    entries + ``stacked`` same-shape groups) so no per-layer restack is
+    materialized. ``Q`` matrices pass through untouched; only ``d``/``rho``
+    entries are rebuilt. Returns ``(singles', stacked', residual)`` where
+    ``residual`` is the scalar drift gauge over truncated sides (f32; 0.0
+    when no side is truncated).
+    """
+    num = jnp.zeros((), jnp.float32)
+    den = jnp.zeros((), jnp.float32)
+
+    def side(entry, prefix, fac):
+        nonlocal num, den
+        out = {}
+        q = entry["Q" + prefix]
+        d, trace = fold_side(q, fac, eps)
+        out["d" + prefix] = d
+        if ("rho" + prefix) in entry:
+            n, rank = q.shape[-2], q.shape[-1]
+            out["rho" + prefix] = fold_rho(trace, d, n, rank)
+            num += jnp.sum(jnp.maximum(trace - jnp.sum(d, axis=-1), 0.0))
+            den += jnp.sum(trace)
+        return out
+
+    new_singles = {}
+    for name, entry in singles.items():
+        e = dict(entry)
+        if "QA" not in entry:  # diagonal-A (embedding) layer
+            e["dA"] = fold_diag(entry["dA"], facs[name]["A_diag"], eps)
+        else:
+            e.update(side(entry, "A", facs[name]["A"]))
+        e.update(side(entry, "G", facs[name]["G"]))
+        new_singles[name] = e
+
+    # Stack row order: shape_groups insertion order over the square layers
+    # that are NOT singles — identical to the order split_eigen_state used
+    # to build the stacks (both iterate the layer dict in insertion order).
+    shapes = {
+        name: (f["G"].shape[0], f["A"].shape[0])
+        for name, f in facs.items()
+        if "A" in f and name not in singles
+    }
+    new_stacked = {}
+    for (g_n, a_n), names in shape_groups(shapes).items():
+        key = f"{g_n}x{a_n}"
+        entry = stacked[key]
+        e = dict(entry)
+        a_stack = jnp.stack([facs[n]["A"] for n in names])
+        g_stack = jnp.stack([facs[n]["G"] for n in names])
+        e.update(side(entry, "A", a_stack))
+        e.update(side(entry, "G", g_stack))
+        new_stacked[key] = e
+
+    residual = num / jnp.maximum(den, jnp.float32(1e-30))
+    return new_singles, new_stacked, residual

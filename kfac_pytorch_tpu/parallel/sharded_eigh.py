@@ -759,6 +759,85 @@ def owner_spectrum_mass(
     return _inner(factor_shard, eigen_shard)
 
 
+def owner_stream_fold(
+    factor_shard: Dict[str, jnp.ndarray],
+    eigen_shard: Dict[str, Dict[str, jnp.ndarray]],
+    plan,
+    mesh: Mesh,
+    axis_name: str = "data",
+    eps: float = 1e-10,
+    rank_fn=None,
+) -> Tuple[Dict[str, Dict[str, jnp.ndarray]], jnp.ndarray]:
+    """Owner-sharded streaming fold (ops/streaming.py, owner form).
+
+    Each device folds its own shard rows' freshly merged factors through the
+    on-owner bases — ``d = diag(Qᵀ F Q)`` per row via two batched einsums,
+    ``rho`` from the leftover trace — and contributes its valid rows to the
+    drift gauge; one psum pair merges the residual partials into a
+    replicated scalar. ``Q`` stacks pass through untouched, so the compiled
+    capture step stays matmul-only (zero eigh custom-calls) and the only
+    collective is the gauge psum. Pad rows hold zero factors (fed only by
+    the EMA decay), fold to zeros harmlessly, and are masked out of the
+    gauge by the plan's validity table. Returns
+    ``(new_eigen_shard, residual)``.
+    """
+    import numpy as np
+
+    valid = {
+        n: jnp.asarray(np.asarray(plan.valid_rows(n)), jnp.float32)
+        for n in plan.group_sizes
+        if rank_fn is not None and rank_fn(n) is not None
+    }
+    axes = tuple(mesh.axis_names)
+    eigen_specs = jax.tree_util.tree_map(lambda _: P(axis_name), eigen_shard)
+
+    @partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(axis_name), factor_shard),
+            eigen_specs,
+        ),
+        out_specs=(eigen_specs, P()),
+        check_vma=False,
+    )
+    def _inner(shard, eigen):
+        dev = lax.axis_index(axis_name)
+        num = jnp.float32(0.0)
+        den = jnp.float32(0.0)
+        out = {}
+        for n in plan.group_sizes:
+            key = f"n{n}"
+            rank = rank_fn(n) if rank_fn is not None else None
+            q = eigen[key]["Q"].astype(jnp.float32)  # [rows, n, r|n]
+            f = symmetrize(shard[key].astype(jnp.float32))
+            t = jnp.einsum(
+                "bij,bjr->bir", f, q, precision=lax.Precision.HIGHEST
+            )
+            d = jnp.einsum(
+                "bir,bir->br", t, q, precision=lax.Precision.HIGHEST
+            )
+            d = d * (d > eps)
+            entry = {"Q": eigen[key]["Q"], "d": d}
+            if rank is not None:
+                traces = jnp.trace(f, axis1=-2, axis2=-1)
+                leftover = jnp.maximum(traces - jnp.sum(d, axis=-1), 0.0)
+                entry["rho"] = leftover / float(max(n - rank, 1))
+                vmask = jnp.take(valid[n], dev, axis=0)  # [rows]
+                num = num + jnp.sum(leftover * vmask)
+                den = den + jnp.sum(traces * vmask)
+            out[key] = entry
+        for n in plan.diag_group_sizes:
+            key = f"v{n}"
+            diag = shard[key].astype(jnp.float32)
+            out[key] = {"d": diag * (diag > eps)}
+        num = lax.psum(num, axes)
+        den = lax.psum(den, axes)
+        return out, num / jnp.maximum(den, 1e-30)
+
+    return _inner(factor_shard, eigen_shard)
+
+
 def replicated_eigen_update(
     factors: Dict[str, Dict[str, jnp.ndarray]],
     diag_blocks_per_layer: Dict[str, int],

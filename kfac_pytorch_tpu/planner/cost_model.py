@@ -51,6 +51,10 @@ RSVD_MIN_SPEEDUP = 2.0
 #: default threshold (a model with all sides < 512 truncates nothing).
 RSVD_SIDE_THRESHOLD = 512
 RSVD_RANK = 128
+#: drift gauge trip point for the streaming solver the production profile
+#: engages in place of periodic rsvd: re-orthonormalize when the retained
+#: bases stop explaining 95% of the curvature mass.
+STREAM_DRIFT_THRESHOLD = 0.05
 #: chunk the refresh until the per-boundary eigh spike is no more than
 #: this multiple of one step's precondition work.
 CHUNK_SPIKE_BUDGET = 32
@@ -147,7 +151,7 @@ def model_facts(params, layers=None) -> ModelFacts:
 def _rank_fn_for(plan: Plan):
     """The size→rank policy a plan implies — same rule as
     ``KFAC._rank_for`` so planner costs match runtime layouts."""
-    if plan.solver != "rsvd":
+    if plan.solver not in ("rsvd", "streaming"):
         return None
 
     def rank_for(n: int) -> Optional[int]:
@@ -237,11 +241,15 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     sides = _dense_sides(facts)
     max_side = max(sides) if sides else 0
 
-    # solver: truncate when it actually shrinks the refresh enough
+    # solver: truncate when it actually shrinks the refresh enough. Where
+    # periodic rsvd pays off, streaming pays off strictly more: the same
+    # truncated layout, but the recurring refresh becomes a drift-gated
+    # re-orth while capture steps fold with matmuls only.
     candidate = Plan(
-        solver="rsvd",
+        solver="streaming",
         solver_rank=RSVD_RANK,
         solver_auto_threshold=RSVD_SIDE_THRESHOLD,
+        stream_drift_threshold=STREAM_DRIFT_THRESHOLD,
     )
     dense_cost = refresh_cost(facts, Plan())
     rsvd_cost = refresh_cost(facts, candidate)
@@ -254,10 +262,11 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
 
     # chunks: spread the refresh spike until it is within budget of one
     # step's precondition work (scheduler clamps k_eff to the refresh
-    # interval, so cap there too)
+    # interval, so cap there too). Streaming has no recurring spike to
+    # spread (streaming_vs_chunks) — chunks stay 1.
     precond = precondition_cost(facts)
     resolved_refresh = refresh_cost(facts, plan)
-    if precond > 0:
+    if precond > 0 and plan.solver != "streaming":
         want = math.ceil(resolved_refresh / (CHUNK_SPIKE_BUDGET * precond))
         chunks = max(1, min(want, MAX_CHUNKS, env.kfac_update_freq))
     else:
@@ -291,7 +300,10 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     # slip into (deferred flushes or a chunked refresh).
     if env.world > 1:
         plan = dataclasses.replace(plan, comm_overlap=True)
-        if plan.factor_comm_freq > 1 or plan.eigh_chunks > 1:
+        # streaming has no pending swap to slip (streaming_vs_swap_slip)
+        if (
+            plan.factor_comm_freq > 1 or plan.eigh_chunks > 1
+        ) and plan.solver != "streaming":
             plan = dataclasses.replace(plan, staleness_budget=1)
 
     # kernel: pin the fused capture kernels where they are fast paths —

@@ -46,6 +46,7 @@ LEVER_FIELDS = (
     "factor_sharding",
     "comm_overlap",
     "staleness_budget",
+    "stream_drift_threshold",
 )
 
 
@@ -69,6 +70,10 @@ class Plan:
     factor_sharding: str = "replicated"
     comm_overlap: bool = False
     staleness_budget: int = 0
+    # Only matters when solver="streaming" (mirrors the constructor
+    # default): drift-gauge level above which the cadence
+    # re-orthonormalizes at a kfac_update_freq boundary.
+    stream_drift_threshold: float = 0.05
 
     def kfac_kwargs(self) -> Dict[str, object]:
         """The KFAC constructor kwargs this plan pins."""
@@ -77,10 +82,10 @@ class Plan:
     def non_default_levers(self) -> Tuple[str, ...]:
         """Lever names set away from their bitwise-inert defaults.
 
-        ``solver_rank``/``solver_auto_threshold`` count only when the rsvd
-        solver is actually on, and ``factor_kernel`` counts only when
-        pinned away from ``auto`` — matching what changes the compiled
-        program.
+        ``solver_rank``/``solver_auto_threshold``/``stream_drift_threshold``
+        count only when a truncating solver is actually on, and
+        ``factor_kernel`` counts only when pinned away from ``auto`` —
+        matching what changes the compiled program.
         """
         default = Plan()
         out = []
@@ -106,6 +111,10 @@ class Plan:
                 kwargs[f] = int(kwargs[f])
         if "comm_overlap" in kwargs:
             kwargs["comm_overlap"] = bool(kwargs["comm_overlap"])
+        if "stream_drift_threshold" in kwargs:
+            kwargs["stream_drift_threshold"] = float(
+                kwargs["stream_drift_threshold"]
+            )
         return cls(**kwargs)
 
     # -- checkpoint form --------------------------------------------------
@@ -116,8 +125,13 @@ class Plan:
 
     _KERNELS = ("auto", "pallas", "dense")
     _COMM_DTYPES = ("f32", "bf16")
-    _SOLVERS = ("eigh", "rsvd")
+    # "streaming" appended at the END: the encoded index rides inside
+    # checkpoints, so existing entries must keep their positions.
+    _SOLVERS = ("eigh", "rsvd", "streaming")
     _SHARDINGS = ("replicated", "owner")
+    # stream_drift_threshold rides the int32 checkpoint encoding in
+    # micro-units (1e-6); plenty for a [0, ~2000] gauge threshold.
+    _DRIFT_SCALE = 1_000_000
 
     def to_state(self) -> Dict[str, np.ndarray]:
         """Array-leaved pytree form (checkpointable via orbax)."""
@@ -132,6 +146,9 @@ class Plan:
             "factor_sharding": self._SHARDINGS.index(self.factor_sharding),
             "comm_overlap": int(self.comm_overlap),
             "staleness_budget": self.staleness_budget,
+            "stream_drift_threshold": int(
+                round(self.stream_drift_threshold * self._DRIFT_SCALE)
+            ),
         }
         return {k: np.asarray(v, np.int32) for k, v in enc.items()}
 
@@ -150,6 +167,14 @@ class Plan:
             # absent in pre-overlap checkpoints: default to inert
             comm_overlap=bool(g.get("comm_overlap", 0)),
             staleness_budget=g.get("staleness_budget", 0),
+            # absent in pre-streaming checkpoints: the field default
+            stream_drift_threshold=(
+                g.get(
+                    "stream_drift_threshold",
+                    int(round(0.05 * cls._DRIFT_SCALE)),
+                )
+                / cls._DRIFT_SCALE
+            ),
         )
 
     def describe(self) -> str:
@@ -167,10 +192,17 @@ class Plan:
         if "factor_comm_freq" in on:
             bits.append(f"factor_comm_freq={self.factor_comm_freq}")
         if "solver" in on:
-            bits.append(
-                f"solver=rsvd(rank={self.solver_rank},"
-                f"threshold={self.solver_auto_threshold})"
-            )
+            if self.solver == "streaming":
+                bits.append(
+                    f"solver=streaming(rank={self.solver_rank},"
+                    f"threshold={self.solver_auto_threshold},"
+                    f"drift={self.stream_drift_threshold})"
+                )
+            else:
+                bits.append(
+                    f"solver={self.solver}(rank={self.solver_rank},"
+                    f"threshold={self.solver_auto_threshold})"
+                )
         if "factor_sharding" in on:
             bits.append("factor_sharding=owner")
         if "comm_overlap" in on:
@@ -269,21 +301,22 @@ RULES: Tuple[Rule, ...] = (
     ),
     Rule(
         name="rsvd_vs_inverse",
-        applies=lambda p: p.solver == "rsvd",
+        applies=lambda p: p.solver != "eigh",
         conflicts=lambda p, e: e.precond_method == "inverse",
         drop=("solver",),
         enforced_by="constructor",
-        message="solver='rsvd' feeds the eigenbasis (Woodbury) apply path; "
-                "precond_method='inverse' would silently ignore it",
+        message="a truncating solver (rsvd/streaming) feeds the eigenbasis "
+                "(Woodbury) apply path; precond_method='inverse' would "
+                "silently ignore it",
     ),
     Rule(
         name="rsvd_vs_diag_blocks",
-        applies=lambda p: p.solver == "rsvd",
+        applies=lambda p: p.solver != "eigh",
         conflicts=lambda p, e: e.diag_blocks > 1,
         drop=("solver",),
         enforced_by="constructor",
-        message="solver='rsvd' stores one truncated basis per whole factor; "
-                "diag_blocks > 1 carves factors into blocks",
+        message="a truncating solver (rsvd/streaming) stores one basis per "
+                "whole factor; diag_blocks > 1 carves factors into blocks",
     ),
     Rule(
         name="owner_vs_inverse",
@@ -388,6 +421,29 @@ RULES: Tuple[Rule, ...] = (
         enforced_by="degrade",
         message="comm_overlap=True has no effect without a multi-device "
                 "mesh — there is no factor exchange to overlap",
+    ),
+    # Plan-internal streaming exclusions — BEFORE staleness_requires_slack
+    # (which must stay last) so a plan that keeps streaming sheds its
+    # chunk/budget levers first, exactly as the constructor refuses them.
+    Rule(
+        name="streaming_vs_chunks",
+        applies=lambda p: p.solver == "streaming",
+        conflicts=lambda p, e: p.eigh_chunks > 1,
+        drop=("eigh_chunks",),
+        enforced_by="constructor",
+        message="solver='streaming' replaces the periodic refresh with a "
+                "per-step fold — no recurring eigh spike remains for "
+                "eigh_chunks > 1 to spread",
+    ),
+    Rule(
+        name="streaming_vs_swap_slip",
+        applies=lambda p: p.solver == "streaming",
+        conflicts=lambda p, e: p.staleness_budget > 0,
+        drop=("staleness_budget",),
+        enforced_by="constructor",
+        message="solver='streaming' has no pending eigen swap to slip — "
+                "re-orthonormalizations land in place on drift boundaries, "
+                "so a staleness_budget would silently mean nothing",
     ),
     # Last on purpose: its conflict is plan-internal, so it must see the
     # plan AFTER every rule above has cleared levers — a fitted plan that

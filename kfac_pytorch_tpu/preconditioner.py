@@ -45,6 +45,7 @@ from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.ops import factor_kernels as factor_kernel_ops
 from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
+from kfac_pytorch_tpu.ops import streaming as streaming_ops
 from kfac_pytorch_tpu.parallel.assignment import (
     layer_assignment,
     plan_eigh_chunks,
@@ -59,6 +60,7 @@ from kfac_pytorch_tpu.parallel.sharded_eigh import (
     owner_eigen_chunk_update,
     owner_eigen_update,
     owner_spectrum_mass,
+    owner_stream_fold,
     replicated_eigen_chunk_update,
     replicated_eigen_update,
     sharded_eigen_chunk_update,
@@ -151,6 +153,7 @@ class KFAC:
         factor_sharding: str = "replicated",
         comm_overlap: bool = False,
         staleness_budget: int = 0,
+        stream_drift_threshold: float = 0.05,
         profile: Optional[Any] = None,
         profile_shapes: Optional[Any] = None,
     ):
@@ -349,6 +352,7 @@ class KFAC:
                 "factor_sharding": factor_sharding,
                 "comm_overlap": comm_overlap,
                 "staleness_budget": staleness_budget,
+                "stream_drift_threshold": stream_drift_threshold,
             }
             for field, value in plan.kfac_kwargs().items():
                 if levers[field] == getattr(plan_defaults, field):
@@ -363,6 +367,7 @@ class KFAC:
             factor_sharding = levers["factor_sharding"]
             comm_overlap = levers["comm_overlap"]
             staleness_budget = levers["staleness_budget"]
+            stream_drift_threshold = levers["stream_drift_threshold"]
             self.plan = plan
             self.plan_dropped = tuple(dropped)
             self.plan_report = report
@@ -383,15 +388,20 @@ class KFAC:
             )
         self.eigh_chunks = int(eigh_chunks)
         # Curvature solver for the refresh: "eigh" (full QDWH/syevd
-        # eigendecomposition, reference parity, bitwise-inert default) or
+        # eigendecomposition, reference parity, bitwise-inert default),
         # "rsvd" (randomized truncated eigensolve, ops/rsvd.py): factors with
         # side n ≥ solver_auto_threshold keep only their top solver_rank
         # eigenpairs plus a residual-trace diagonal, refresh via batched
         # matmuls instead of eigh custom-calls, and precondition through the
-        # low-rank-plus-diagonal Woodbury path (ops/precondition.py). Factors
-        # below the threshold — or with solver_rank ≥ n, where truncation
-        # buys nothing — stay on the dense path unchanged.
-        _validate("solver", solver in ("eigh", "rsvd"), solver)
+        # low-rank-plus-diagonal Woodbury path (ops/precondition.py), or
+        # "streaming" (rsvd state layout, but the periodic refresh is
+        # replaced by a per-capture-step matmul-only fold of the EMA'd
+        # factors through the retained bases — ops/streaming.py; the full
+        # rsvd refresh runs only as a re-orthonormalization when the
+        # residual-mass drift gauge crosses stream_drift_threshold).
+        # Factors below the threshold — or with solver_rank ≥ n, where
+        # truncation buys nothing — stay on the dense path unchanged.
+        _validate("solver", solver in ("eigh", "rsvd", "streaming"), solver)
         _validate(
             "solver_rank",
             isinstance(solver_rank, int) and 0 < solver_rank,
@@ -402,21 +412,50 @@ class KFAC:
             isinstance(solver_auto_threshold, int) and 0 < solver_auto_threshold,
             solver_auto_threshold,
         )
-        if solver == "rsvd" and precond_method == "inverse":
+        if solver != "eigh" and precond_method == "inverse":
             raise ValueError(
-                "solver='rsvd' produces a truncated eigenbasis consumed by "
-                "the eigenbasis (Woodbury) apply path; precond_method="
+                f"solver={solver!r} produces a truncated eigenbasis consumed "
+                "by the eigenbasis (Woodbury) apply path; precond_method="
                 "'inverse' preconditions with explicit Cholesky inverses and "
                 "would silently ignore the configured solver"
             )
-        if solver == "rsvd" and diag_blocks != 1:
+        if solver != "eigh" and diag_blocks != 1:
             raise ValueError(
-                "solver='rsvd' stores one (Q_r, d_r, rho) triple per whole "
-                "factor; diag_blocks > 1 carves factors into diagonal blocks "
-                "whose truncated bases cannot share that layout — pick one "
-                "approximation"
+                f"solver={solver!r} stores one (Q_r, d_r, rho) triple per "
+                "whole factor; diag_blocks > 1 carves factors into diagonal "
+                "blocks whose truncated bases cannot share that layout — "
+                "pick one approximation"
             )
+        if solver == "streaming" and eigh_chunks > 1:
+            raise ValueError(
+                "solver='streaming' replaces the periodic refresh with a "
+                "per-step fold — there is no recurring eigh spike left for "
+                "eigh_chunks > 1 to spread, and the chunk plan's double "
+                "buffer would shadow the streamed tables (planner rule "
+                "streaming_vs_chunks)"
+            )
+        if solver == "streaming" and staleness_budget > 0:
+            raise ValueError(
+                "solver='streaming' has no pending eigen swap to slip — "
+                "re-orthonormalizations land in place on drift boundaries — "
+                "so a staleness_budget would silently mean nothing on the "
+                "eigen side (planner rule streaming_vs_swap_slip); leave "
+                "staleness_budget=0"
+            )
+        _validate(
+            "stream_drift_threshold",
+            isinstance(stream_drift_threshold, (int, float))
+            and 0.0 <= float(stream_drift_threshold),
+            stream_drift_threshold,
+        )
         self.solver = solver
+        self.stream_drift_threshold = float(stream_drift_threshold)
+        # Host-side drift source for the streaming re-orth decision: a
+        # zero-arg callable returning the latest device residual-mass gauge
+        # (trainers wire it to state["stream_residual"]). None → the cadence
+        # re-orthonormalizes at every kfac_update_freq boundary, the safe
+        # (and deterministic) degenerate schedule.
+        self.stream_drift_signal = None
         self.solver_rank = int(solver_rank)
         self.solver_auto_threshold = int(solver_auto_threshold)
         # Where the factor running averages / eigenbases LIVE on the mesh:
@@ -646,7 +685,7 @@ class KFAC:
         the same answer; init(), the refresh planners, and the sharded
         updates all route through here.
         """
-        if self.solver != "rsvd":
+        if self.solver not in ("rsvd", "streaming"):
             return None
         if n < self.solver_auto_threshold or self.solver_rank >= n:
             return None
@@ -656,7 +695,9 @@ class KFAC:
         """``rank_fn`` to thread into the refresh planners/updates: ``None``
         (not a function) when the solver is dense, so those paths stay
         bitwise-identical to the pre-solver code."""
-        return self._rank_for if self.solver == "rsvd" else None
+        return (
+            self._rank_for if self.solver in ("rsvd", "streaming") else None
+        )
 
     def _spectrum_mass(
         self,
@@ -917,9 +958,16 @@ class KFAC:
                 new_state["eigen_pending_shard"] = jax.tree_util.tree_map(
                     jnp.zeros_like, eigen_shard
                 )
-        if self.solver == "rsvd":
+        if self.solver in ("rsvd", "streaming"):
             new_state["spectrum_mass"] = state.get(
                 "spectrum_mass", jnp.zeros((), jnp.float32)
+            )
+        if self.solver == "streaming":
+            new_state["stream_residual"] = state.get(
+                "stream_residual", jnp.zeros((), jnp.float32)
+            )
+            new_state["stream_fold_steps"] = state.get(
+                "stream_fold_steps", jnp.zeros((), jnp.int32)
             )
         if self.factor_comm.defer:
             new_state["factor_local"] = {
@@ -1137,12 +1185,20 @@ class KFAC:
             # monolithic configuration's pytree (and checkpoints) are
             # untouched.
             state["eigen_pending"] = {n: dict(e) for n, e in eigen.items()}
-        if self.solver == "rsvd":
+        if self.solver in ("rsvd", "streaming"):
             # Fraction of total factor trace the truncated bases captured at
             # the last refresh (1.0 when no side crossed the threshold) —
             # the in-graph source of the kfac/spectrum_mass_captured gauge.
             # Fixed from init like the other optional state keys.
             state["spectrum_mass"] = jnp.zeros((), jnp.float32)
+        if self.solver == "streaming":
+            # Streaming drift bookkeeping: the residual-mass gauge the fold
+            # writes each capture step (the device source of the
+            # kfac/stream_residual_mass gauge and the host drift signal) and
+            # the count of folds since the last re-orthonormalization. Fixed
+            # from init like the other optional state keys.
+            state["stream_residual"] = jnp.zeros((), jnp.float32)
+            state["stream_fold_steps"] = jnp.zeros((), jnp.int32)
         if self.factor_comm.defer:
             # Deferred factor communication: the factor running averages
             # double as per-replica LOCAL accumulators between flushes (no
@@ -1217,8 +1273,11 @@ class KFAC:
             state["eigen_pending_shard"] = jax.tree_util.tree_map(
                 jnp.zeros_like, eigen_shard
             )
-        if self.solver == "rsvd":
+        if self.solver in ("rsvd", "streaming"):
             state["spectrum_mass"] = jnp.zeros((), jnp.float32)
+        if self.solver == "streaming":
+            state["stream_residual"] = jnp.zeros((), jnp.float32)
+            state["stream_fold_steps"] = jnp.zeros((), jnp.int32)
         if self.factor_comm.defer:
             # Deferred owner mode: unlike the replicated plane (where the
             # factors themselves double as local accumulators), non-owners
@@ -1502,7 +1561,7 @@ class KFAC:
                     if "A_diag" in facs[n]:
                         d = facs[n]["A_diag"]
                         eigen[n]["dA"] = d * (d > self.eps)
-                if self.solver == "rsvd":
+                if self.solver in ("rsvd", "streaming"):
                     spectrum_mass = self._spectrum_mass(facs, eigen, names)
                 if self.track_diagnostics:
                     # grab the f32 per-layer spectra while the eigen dict is
@@ -1616,6 +1675,31 @@ class KFAC:
                 }
             eigen, stacked = precond_ops.split_eigen_state(full)
 
+        # Streaming curvature (solver="streaming"): capture steps fold the
+        # freshly EMA'd (and, in deferred mode, freshly merged) factors
+        # through the retained bases — matmul-only d/rho rebuild plus the
+        # residual-mass drift gauge (ops/streaming.py). Re-orthonormalization
+        # steps are plain update_eigen refreshes (handled above); they reset
+        # the gauge from the refresh's own spectrum mass.
+        stream_residual = state.get("stream_residual")
+        stream_fold_steps = state.get("stream_fold_steps")
+        if self.solver == "streaming":
+            if update_eigen:
+                stream_residual = jnp.maximum(
+                    1.0 - spectrum_mass, jnp.float32(0.0)
+                )
+                stream_fold_steps = jnp.zeros((), jnp.int32)
+            elif update_factors and (
+                not self.factor_comm.defer or flush_factors
+            ):
+                with tel.span("trace/kfac/stream_fold"):
+                    eigen, stacked, stream_residual = (
+                        streaming_ops.fold_replicated(
+                            facs, eigen, stacked, self.eps
+                        )
+                    )
+                stream_fold_steps = state["stream_fold_steps"] + 1
+
         # Precondition every layer's gradient, every step
         # (kfac_preconditioner.py:401-404) — batched over same-shape layers.
         if not precond_early:
@@ -1634,6 +1718,9 @@ class KFAC:
             new_state["eigen_pending"] = pending
         if spectrum_mass is not None:
             new_state["spectrum_mass"] = spectrum_mass
+        if stream_residual is not None:
+            new_state["stream_residual"] = stream_residual
+            new_state["stream_fold_steps"] = stream_fold_steps
         if "factor_sync_age" in state:
             new_state["factor_sync_age"] = (
                 jnp.zeros((), jnp.int32)
@@ -1850,7 +1937,7 @@ class KFAC:
                     ),
                     **self._owner_diag_eigen(shard, plan),
                 }
-                if self.solver == "rsvd":
+                if self.solver in ("rsvd", "streaming"):
                     spectrum_mass = owner_spectrum_mass(
                         shard,
                         eigen_shard,
@@ -1907,6 +1994,34 @@ class KFAC:
                     rank_fn=self._rank_fn(),
                 )
 
+        # Streaming curvature, owner form: fold the freshly merged shard
+        # stacks through the on-owner bases (shard-local einsums + one psum
+        # for the drift gauge — parallel/sharded_eigh.py::owner_stream_fold).
+        # In deferred mode the fold rides flush steps only, so it always
+        # reads globally-merged factors.
+        stream_residual = state.get("stream_residual")
+        stream_fold_steps = state.get("stream_fold_steps")
+        if self.solver == "streaming":
+            if update_eigen:
+                stream_residual = jnp.maximum(
+                    1.0 - spectrum_mass, jnp.float32(0.0)
+                )
+                stream_fold_steps = jnp.zeros((), jnp.int32)
+            elif update_factors and (
+                not self.factor_comm.defer or flush_factors
+            ):
+                with tel.span("trace/kfac/stream_fold"):
+                    eigen_shard, stream_residual = owner_stream_fold(
+                        shard,
+                        eigen_shard,
+                        plan,
+                        self.mesh,
+                        self.axis_name,
+                        self.eps,
+                        rank_fn=self._rank_fn(),
+                    )
+                stream_fold_steps = state["stream_fold_steps"] + 1
+
         if not precond_early:
             with tel.span("trace/kfac/precondition"):
                 new_grads = self._precondition_owner(
@@ -1925,6 +2040,9 @@ class KFAC:
             new_state["eigen_pending_shard"] = pending
         if spectrum_mass is not None:
             new_state["spectrum_mass"] = spectrum_mass
+        if stream_residual is not None:
+            new_state["stream_residual"] = stream_residual
+            new_state["stream_fold_steps"] = stream_fold_steps
         if local is not None:
             new_state["factor_local"] = local
             new_state["factor_sync_age"] = (

@@ -152,6 +152,20 @@ class EigenRefreshCadence:
     eigendecomposition never reads unmerged factors. With no signal wired
     (``staleness_signal=None``, the default) the ratio reads 0 and the
     schedule is exactly the ``S = 0`` one.
+
+    **Streaming curvature** (``KFAC(solver="streaming")``): the cadence
+    degenerates — no chunk plan, no double buffer, no swap variants (the
+    constructor refuses ``eigh_chunks > 1`` and ``staleness_budget > 0``
+    with this solver). Re-orthonormalization decisions happen ONLY at
+    ``kfac_update_freq`` boundaries, so the re-orth count is structurally
+    bounded by ``ceil(steps / kfac_update_freq)``; between boundaries every
+    capture step folds (matmul-only, inside ``update()``) and the refresh
+    machinery emits nothing. At a boundary the cadence re-orthonormalizes
+    iff the wired drift signal (``kfac.stream_drift_signal``, a zero-arg
+    callable the trainer points at ``state["stream_residual"]``) exceeds
+    ``stream_drift_threshold`` — or unconditionally before the first
+    bootstrap refresh or when no signal is wired (the safe, deterministic
+    degenerate schedule).
     """
 
     def __init__(self, kfac: Optional[KFAC], chunks: Optional[int] = None):
@@ -176,6 +190,9 @@ class EigenRefreshCadence:
         self._flush_owed = False  # a due deferred flush was withheld
         self._flush_slip = 0  # steps the owed flush has slipped
         self._since_flush = 0  # capture steps since the last flush (gauge)
+        # Streaming-solver bookkeeping (solver="streaming" only):
+        self._reorth_count = 0  # re-orthonormalizations so far (gauge)
+        self._stream_signal: Optional[float] = None  # last drift read
 
     def state_dict(self) -> dict:
         """JSON-serializable snapshot of the host-side interval state.
@@ -201,6 +218,7 @@ class EigenRefreshCadence:
             "flush_owed": self._flush_owed,
             "flush_slip": self._flush_slip,
             "since_flush": self._since_flush,
+            "reorth_count": self._reorth_count,
         }
 
     def load_state_dict(self, d: dict) -> None:
@@ -216,6 +234,7 @@ class EigenRefreshCadence:
         self._flush_owed = bool(d.get("flush_owed", False))
         self._flush_slip = int(d.get("flush_slip", 0))
         self._since_flush = int(d.get("since_flush", 0))
+        self._reorth_count = int(d.get("reorth_count", 0))
 
     def _pressure(self) -> float:
         """The measured comm/compute ratio from the trainer-wired signal;
@@ -246,7 +265,27 @@ class EigenRefreshCadence:
         # a swap may slip only into the interval's chunk-free tail, so it
         # always lands before the next refresh window opens
         swap_allowance = min(budget, hp.kfac_update_freq - k_eff)
-        if k_eff == 1:
+        streaming = getattr(self.kfac, "solver", "eigh") == "streaming"
+        if streaming:
+            # Degenerate streaming cadence: re-orth decisions only at
+            # boundaries, gated on the wired drift signal. The constructor
+            # refuses chunks/staleness with this solver, so none of the
+            # chunk/swap machinery below can be live.
+            if boundary:
+                signal = getattr(self.kfac, "stream_drift_signal", None)
+                if not self._bootstrapped or signal is None:
+                    reorth = True
+                else:
+                    self._stream_signal = float(signal())
+                    reorth = self._stream_signal > float(
+                        getattr(self.kfac, "stream_drift_threshold", 0.0)
+                    )
+                if reorth:
+                    flags["update_eigen"] = True
+                    self._bootstrapped = True
+                    self._last_refresh_step = step
+                    self._reorth_count += 1
+        elif k_eff == 1:
             flags["update_eigen"] = boundary
             if boundary:
                 self._last_refresh_step = step
@@ -303,7 +342,15 @@ class EigenRefreshCadence:
             # step, and ALWAYS before eigen reads the factors — both the
             # monolithic refresh and chunk 0 of a pipelined pass (later
             # chunks reuse the merged snapshot already in ``facs``).
-            forced = flags["update_eigen"] or chunk == 0
+            # Streaming mode additionally forces a flush at EVERY boundary:
+            # a skipped re-orth still folds there, and the fold must read
+            # globally-merged factors — keeping the flag a pure function of
+            # the step schedule (never of the drift signal's verdict).
+            forced = (
+                flags["update_eigen"]
+                or chunk == 0
+                or (streaming and boundary)
+            )
             due = flags["update_factors"] and (
                 (step // hp.fac_update_freq) % comm.comm_freq == 0
             )
@@ -344,7 +391,9 @@ class EigenRefreshCadence:
         # by solver without a config side channel).
         tel.set_gauge(
             "kfac/solver",
-            1 if getattr(self.kfac, "solver", "eigh") == "rsvd" else 0,
+            {"rsvd": 1, "streaming": 2}.get(
+                getattr(self.kfac, "solver", "eigh"), 0
+            ),
         )
         tel.set_gauge(
             "kfac/solver_rank", getattr(self.kfac, "solver_rank", 0)
@@ -359,4 +408,16 @@ class EigenRefreshCadence:
         )
         tel.set_gauge("kfac/staleness_age_steps", self._since_flush)
         tel.set_gauge("kfac/eigen_swap_slip", self._swap_slip)
+        if streaming:
+            # Streaming drift gauges: the last host-read residual mass
+            # (-1.0 until a wired signal has been consulted), the running
+            # re-orthonormalization count, and the basis age (same value as
+            # eigen_basis_age_steps, under the streaming name dashboards
+            # key their drift panels on).
+            tel.set_gauge(
+                "kfac/stream_residual_mass",
+                -1.0 if self._stream_signal is None else self._stream_signal,
+            )
+            tel.set_gauge("kfac/stream_reorth_count", self._reorth_count)
+            tel.set_gauge("kfac/stream_basis_age_steps", age)
         return flags

@@ -239,6 +239,9 @@ def make_lm_train_step(
         if kfac_state is not None and "spectrum_mass" in kfac_state:
             # randomized solver only — see training/step.py
             metrics["kfac_spectrum_mass"] = kfac_state["spectrum_mass"]
+        if kfac_state is not None and "stream_residual" in kfac_state:
+            # streaming solver drift gauge — see training/step.py
+            metrics["kfac_stream_residual"] = kfac_state["stream_residual"]
         new_state = TrainState(
             step=state.step + 1,
             params=params,

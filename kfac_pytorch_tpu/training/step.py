@@ -532,6 +532,11 @@ def make_train_step(
             # eigenbases captured at the last refresh (→ the trainer's
             # kfac/spectrum_mass_captured gauge)
             metrics["kfac_spectrum_mass"] = kfac_state["spectrum_mass"]
+        if kfac_state is not None and "stream_residual" in kfac_state:
+            # streaming solver: curvature mass fraction outside the retained
+            # bases after the last fold — the value the trainer hands back to
+            # the cadence via kfac.stream_drift_signal
+            metrics["kfac_stream_residual"] = kfac_state["stream_residual"]
         new_state = TrainState(
             step=state.step + 1,
             params=params,
@@ -613,6 +618,12 @@ def kfac_flags_for_step(
     (scheduler-mutable) update frequencies, and — for the ``diag_warmup``
     gate (kfac_preconditioner.py:361-367) — the current epoch (None → no
     warmup gating, matching the reference's warning path).
+
+    For ``solver="streaming"`` this helper is the degenerate cadence:
+    ``update_eigen`` fires at every ``kfac_update_freq`` boundary, i.e.
+    re-orthonormalize unconditionally. Drift-gated re-orth skipping needs
+    the stateful ``scheduler.EigenRefreshCadence`` with a wired
+    ``kfac.stream_drift_signal``.
     """
     if kfac is None:
         return {"update_factors": False, "update_eigen": False}

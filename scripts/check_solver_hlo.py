@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Pin the randomized solver's matmul-only guarantee in compiled HLO.
+"""Pin the low-rank solvers' matmul-only guarantees in compiled HLO.
 
 ``KFAC(solver="rsvd")`` replaces the full eigendecomposition of every factor
 side at/above ``solver_auto_threshold`` with a randomized truncated
@@ -10,6 +10,13 @@ eigendecomposition custom-calls operating on square dims at/above the
 threshold: the dense program must contain at least one (detector sanity —
 if the backend renames its eigh target this fails loudly instead of
 vacuously passing), the randomized program must contain NONE.
+
+``KFAC(solver="streaming")`` goes further: its steady-state CAPTURE step
+(``update_factors=True, update_eigen=False``) folds statistics through the
+retained bases with matmuls only — ZERO eigh custom-calls of ANY size, and
+no refresh-only collectives (single-device compile: no collective ops at
+all). Its re-orthonormalization program is exactly the rsvd refresh: at
+least one ``(r+p)×(r+p)`` Gram solve, nothing at/above the threshold.
 
 Exit 0 with an "OK" line, 1 with a report. Run from the repo root
 (tier-1 wraps it in a test, tests/test_scripts.py).
@@ -36,6 +43,16 @@ from kfac_pytorch_tpu import KFAC  # noqa: E402
 _SIZES = [300, 300, 10]
 _THRESHOLD = 256
 _RANK = 64
+# ops/rsvd.py DEFAULT_OVERSAMPLE: the streaming re-orth's Gram/Rayleigh–Ritz
+# solves are exactly (rank + oversample)-square
+_OVERSAMPLE = 8
+# collective op mnemonics (any backend spelling) — the streaming capture
+# program must contain none; a hit means a refresh-only collective leaked
+# into the per-step fold
+_COLLECTIVE = re.compile(
+    r"\b(?:all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)\b"
+)
 
 # eigendecomposition custom-call targets across the backends this repo
 # meets: LAPACK syevd on CPU (lapack_ssyevd / lapack_ssyevd_ffi), the
@@ -59,7 +76,7 @@ def _big_eigh_calls(hlo: str, threshold: int) -> list:
     return hits
 
 
-def _refresh_hlo(**solver_kwargs) -> str:
+def _refresh_hlo(update_factors=True, update_eigen=True, **solver_kwargs) -> str:
     r = np.random.RandomState(0)
     params, grads, a_c, g_s = {}, {}, {}, {}
     cin = _SIZES[0]
@@ -83,7 +100,9 @@ def _refresh_hlo(**solver_kwargs) -> str:
     kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
                 layers=names, **solver_kwargs)
     state = kfac.init(params)
-    fn = functools.partial(kfac.update, update_factors=True, update_eigen=True)
+    fn = functools.partial(
+        kfac.update, update_factors=update_factors, update_eigen=update_eigen
+    )
     lowered = jax.jit(fn).lower(
         grads, state, a_contribs=a_c, g_factor_stats=g_s,
         lr=jnp.float32(0.1), damping=jnp.float32(0.01),
@@ -115,11 +134,55 @@ def main() -> int:
         for dim, line in rsvd_hits[:5]:
             print(f"  [{dim}x{dim}] {line}", file=sys.stderr)
         return 1
+
+    stream_kw = dict(solver="streaming", solver_rank=_RANK,
+                     solver_auto_threshold=_THRESHOLD)
+
+    # Steady-state streaming capture: the fold-only program. No eigh of ANY
+    # size, no collective ops (single-device lowering — a collective here
+    # would be a refresh-only exchange leaking into the per-step path).
+    capture_hlo = _refresh_hlo(update_eigen=False, **stream_kw)
+    capture_eighs = _big_eigh_calls(capture_hlo, 1)
+    capture_colls = [
+        ln.strip()[:140] for ln in capture_hlo.splitlines()
+        if _COLLECTIVE.search(ln)
+    ]
+    if capture_eighs or capture_colls:
+        print(
+            "check_solver_hlo: FAIL — the solver='streaming' capture step "
+            f"(fold-only) contains {len(capture_eighs)} eigh custom-call(s) "
+            f"and {len(capture_colls)} collective op(s); it must be "
+            "matmul-only:", file=sys.stderr,
+        )
+        for dim, line in capture_eighs[:5]:
+            print(f"  [{dim}x{dim}] {line}", file=sys.stderr)
+        for line in capture_colls[:5]:
+            print(f"  [collective] {line}", file=sys.stderr)
+        return 1
+
+    # Streaming re-orth: exactly the rsvd refresh — truncated sides solve
+    # (rank+oversample)-square Gram problems, nothing at/above threshold.
+    reorth_hits = _big_eigh_calls(_refresh_hlo(**stream_kw), 1)
+    gram = _RANK + _OVERSAMPLE
+    big = [(d, ln) for d, ln in reorth_hits if d >= _THRESHOLD]
+    if big or not any(d == gram for d, _ in reorth_hits):
+        print(
+            "check_solver_hlo: FAIL — the solver='streaming' re-orth "
+            f"program must solve (rank+oversample)={gram}-square Gram "
+            f"problems and nothing >= {_THRESHOLD}; saw dims "
+            f"{sorted(set(d for d, _ in reorth_hits))}", file=sys.stderr,
+        )
+        for dim, line in big[:5]:
+            print(f"  [{dim}x{dim}] {line}", file=sys.stderr)
+        return 1
+
     print(
         f"check_solver_hlo: OK — dense refresh has {len(dense_hits)} "
         f"eigh custom-call(s) at dim >= {_THRESHOLD} "
         f"(largest {max(d for d, _ in dense_hits)}); rsvd refresh has zero "
-        "(only sub-threshold Gram/Rayleigh–Ritz solves remain)"
+        "(only sub-threshold Gram/Rayleigh–Ritz solves remain); streaming "
+        "capture is matmul-only (zero eighs, zero collectives) and its "
+        f"re-orth solves {gram}-square Gram problems"
     )
     return 0
 

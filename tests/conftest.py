@@ -24,6 +24,18 @@ if os.environ.get("KFAC_TEST_TPU") == "1":
 else:
     from kfac_pytorch_tpu.platform_override import force_cpu_devices
 
+    # The suite is XLA-compile-bound on the virtual mesh and tier-1 is
+    # wall-clock capped; dial LLVM codegen down for test compiles (~20%
+    # faster end to end). HLO-level semantics — fusion, collective counts,
+    # FP results — are unchanged, so parity/bitwise/lint tests are
+    # unaffected; compiled-code runtime does not matter at test sizes.
+    if "--xla_backend_optimization_level" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_backend_optimization_level=0"
+            + " --xla_llvm_disable_expensive_passes=true"
+        ).strip()
+
     assert force_cpu_devices(8), "JAX backend initialized before conftest ran"
 
 

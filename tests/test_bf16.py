@@ -5,12 +5,14 @@ factor math (SURVEY.md §7.3.3 — eigendecompositions must stay f32).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kfac_pytorch_tpu import KFAC
 from kfac_pytorch_tpu.models import cifar_resnet
 from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
 
 
+@pytest.mark.slow  # heaviest XLA compile in the file; tier-1 is wall-clock capped
 def test_bf16_model_kfac_trains():
     model = cifar_resnet.get_model("resnet20", dtype=jnp.bfloat16)
     r = np.random.RandomState(0)

@@ -263,6 +263,66 @@ def test_mid_interval_resume_bitwise(tmp_path, extra):
     _assert_bitwise(final, rfinal)
 
 
+@pytest.mark.parametrize(
+    "extra",
+    [
+        pytest.param({}, id="replicated"),
+        pytest.param(
+            {"factor_sharding": "owner", "factor_comm_freq": 2}, id="owner"
+        ),
+    ],
+)
+def test_mid_stream_resume_bitwise(tmp_path, extra):
+    """Streaming solver, snapshot between re-orthonormalizations: the
+    basis is several folds old (``stream_fold_steps > 0``), the drift
+    gauge is live in ``stream_residual``, and the cadence's bootstrap bit
+    and re-orth counter live host-side. With a quiet drift signal (every
+    post-bootstrap boundary skipped) the resumed run must finish
+    bitwise-equal to the uninterrupted one — in particular it must NOT
+    re-bootstrap a re-orth at the first resumed boundary."""
+    mesh = data_parallel_mesh()
+    kw = dict(
+        kfac_update_freq=4, solver="streaming", solver_rank=8,
+        solver_auto_threshold=16, stream_drift_threshold=0.5, **extra,
+    )
+    kfac, state, fn, b = _build(kw, mesh)
+    kfac.stream_drift_signal = lambda: 0.0  # quiet: bootstrap re-orth only
+    cad = EigenRefreshCadence(kfac)
+
+    state = _run_steps(fn, cad, state, b, 0, 7)
+    # mid-stream preconditions: folds since the (only) re-orth, live gauge
+    assert int(jax.device_get(state.kfac_state["stream_fold_steps"])) > 0
+    assert float(jax.device_get(state.kfac_state["stream_residual"])) >= 0.0
+    assert cad.state_dict()["reorth_count"] == 1
+    sup = Supervisor(str(tmp_path), kfac=kfac, cadence=cad)
+    sup.snapshot(7, state, sync=True)
+
+    # uninterrupted: straight through the step-8 boundary (skipped) to 12
+    final = _run_steps(fn, cad, state, b, 7, 12)
+
+    kfac2, state2, fn2, b2 = _build(kw, mesh)
+    kfac2.stream_drift_signal = lambda: 0.0
+    cad2 = EigenRefreshCadence(kfac2)
+    sup2 = Supervisor(str(tmp_path), kfac=kfac2, cadence=cad2)
+    hit = sup2.scan_resume(jax.device_get(state2), params=state2.params)
+    assert hit is not None
+    rstate, manifest, rstep = hit
+    assert rstep == 7
+    assert "stream_residual" in manifest["kfac_state_keys"]
+    assert "stream_fold_steps" in manifest["kfac_state_keys"]
+    assert cad2.state_dict()["reorth_count"] == 1
+    assert cad2.state_dict()["bootstrapped"]
+    kstate = rstate.kfac_state
+    rstate = jax.device_put(
+        rstate.replace(kfac_state=None), NamedSharding(mesh, P())
+    )
+    rstate = rstate.replace(kfac_state=kstate)
+    rfinal = _run_steps(fn2, cad2, rstate, b2, 7, 12)
+
+    _assert_bitwise(final, rfinal)
+    assert cad2.state_dict()["reorth_count"] == 1  # boundary 8 stayed quiet
+
+
 # ------------------------------------------------------------ mesh resize
 
 

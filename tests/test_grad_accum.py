@@ -11,6 +11,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kfac_pytorch_tpu import KFAC
@@ -166,6 +167,7 @@ def test_accum_stats_all_microbatches_match_full_batch():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # heaviest XLA compile in the file; tier-1 is wall-clock capped
 def test_accum_with_bn_and_mesh():
     """ResNet-20 (BN) + K-FAC + accumulation on the 8-device mesh runs and
     decreases loss; accum batches shard P(None, 'data')."""

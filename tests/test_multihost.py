@@ -139,6 +139,91 @@ out["losses2"] = losses2
 out["param_sum2"] = float(jax.device_get(
     jax.tree_util.tree_reduce(lambda a, b: a + jnp.sum(b), st2.params, jnp.float32(0))
 ))
+
+# PR-13 features on a REAL 2-process world: owner sharding's scatter_merge
+# plus the streaming on-owner fold, then a streaming snapshot/resume cycle
+# through the elastic supervisor (orbax multi-process save).
+from kfac_pytorch_tpu import EigenRefreshCadence
+from kfac_pytorch_tpu.elastic import Supervisor
+
+def _psum(tree):
+    return float(jax.device_get(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.sum(b), tree, jnp.float32(0))))
+
+def _fresh_params():
+    # the train step donates its state, so every TrainState needs its own
+    # copy — the earlier blocks' params buffers are already deleted
+    return model.init(jax.random.PRNGKey(0), jnp.asarray(X))["params"]
+
+stream_kw = dict(damping=0.003, mesh=mesh, solver="streaming", solver_rank=4,
+                 solver_auto_threshold=8, fac_update_freq=1,
+                 kfac_update_freq=2)
+
+# (a) owner-sharded streaming: scatter_merge feeds the on-owner fold
+kfac3 = KFAC(factor_sharding="owner", **stream_kw)
+params3 = _fresh_params()
+st3 = TrainState(step=jnp.zeros((), jnp.int32), params=params3, batch_stats={},
+                 opt_state=tx.init(params3), kfac_state=kfac3.init(params3))
+kst = st3.kfac_state
+st3 = jax.device_put(st3.replace(kfac_state=None), NamedSharding(mesh, P()))
+kst = jax.jit(lambda s: s, out_shardings=kfac3.state_shardings(kst))(kst)
+st3 = st3.replace(kfac_state=kst)
+fn3 = make_train_step(model, tx, kfac3, train_kwargs={"train": True},
+                      mesh=mesh, grad_comm_dtype=jnp.float32)
+cad3 = EigenRefreshCadence(kfac3)
+for i in range(4):
+    st3, _ = fn3(st3, batch, jnp.float32(0.1), jnp.float32(0.003),
+                 **cad3.flags_for_step(i))
+out["owner_stream_param_sum"] = _psum(st3.params)
+out["owner_stream_residual"] = float(jax.device_get(
+    st3.kfac_state["stream_residual"]))
+out["owner_stream_folds"] = int(jax.device_get(
+    st3.kfac_state["stream_fold_steps"]))
+out["owner_stream_reorths"] = cad3.state_dict()["reorth_count"]
+
+# (b) streaming snapshot/resume over the 2-process world
+snapdir = os.path.join(os.environ["KFAC_SNAPDIR"], "stream")
+kfac4 = KFAC(**stream_kw)
+params4 = _fresh_params()
+st4 = TrainState(step=jnp.zeros((), jnp.int32), params=params4, batch_stats={},
+                 opt_state=tx.init(params4), kfac_state=kfac4.init(params4))
+st4 = jax.device_put(st4, NamedSharding(mesh, P()))
+fn4 = make_train_step(model, tx, kfac4, train_kwargs={"train": True})
+cad4 = EigenRefreshCadence(kfac4)
+for i in range(2):
+    st4, _ = fn4(st4, batch, jnp.float32(0.1), jnp.float32(0.003),
+                 **cad4.flags_for_step(i))
+sup = Supervisor(snapdir, kfac=kfac4, cadence=cad4)
+sup.snapshot(2, st4, sync=True)
+launch.barrier("stream-snap")  # manifest lands on process 0 only
+for i in range(2, 4):
+    st4, _ = fn4(st4, batch, jnp.float32(0.1), jnp.float32(0.003),
+                 **cad4.flags_for_step(i))
+
+kfac5 = KFAC(**stream_kw)
+params5 = _fresh_params()
+st5 = TrainState(step=jnp.zeros((), jnp.int32), params=params5, batch_stats={},
+                 opt_state=tx.init(params5), kfac_state=kfac5.init(params5))
+cad5 = EigenRefreshCadence(kfac5)
+sup5 = Supervisor(snapdir, kfac=kfac5, cadence=cad5)
+hit = sup5.scan_resume(jax.device_get(st5), params=st5.params)
+assert hit is not None, "no snapshot found on resume"
+r5, manifest5, rstep5 = hit
+assert rstep5 == 2, rstep5
+assert "stream_residual" in manifest5["kfac_state_keys"]
+r5 = jax.device_put(r5, NamedSharding(mesh, P()))
+fn5 = make_train_step(model, tx, kfac5, train_kwargs={"train": True})
+for i in range(2, 4):
+    r5, _ = fn5(r5, batch, jnp.float32(0.1), jnp.float32(0.003),
+                **cad5.flags_for_step(i))
+out["stream_resume_bitwise"] = bool(all(
+    np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(st4.params)),
+        jax.tree_util.tree_leaves(jax.device_get(r5.params)),
+    )
+))
+out["stream_resume_param_sum"] = _psum(r5.params)
 print("RESULT " + json.dumps(out), flush=True)
 """
 
@@ -213,18 +298,21 @@ def _gloo_capability():
     return _PROBE_RESULT
 
 
-@pytest.fixture(scope="module")
-def world():
-    """Launch the 2-process world ONCE per module; per-feature tests below
-    assert against its published results (round-4 verdict, Weak #7: one
-    monolithic test made any failure an opaque single red)."""
-    ok, reason = _gloo_capability()
-    if not ok:
-        pytest.skip(f"CPU gloo collectives backend unavailable: {reason}")
+# Signature of the broken-gloo-transport abort (same condition the probe
+# guards against, but it can also strike mid-worker on collectives larger
+# than the probe's single host-value broadcast).
+_GLOO_ABORT = "gloo::EnforceNotMet"
+
+
+def _launch_world_once(tmp_path_factory):
+    """One attempt at the 2-process world. Returns (results, None) on
+    success, (None, reason) when the run died with the documented gloo
+    transport abort, and raises AssertionError for any other failure."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
 
+    snapdir = str(tmp_path_factory.mktemp("multihost-snaps"))
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -234,6 +322,7 @@ def world():
             NUM_PROCESSES="2",
             PROCESS_ID=str(pid),
             KFAC_REPO=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            KFAC_SNAPDIR=snapdir,
         )
         procs.append(
             subprocess.Popen(
@@ -245,13 +334,51 @@ def world():
             )
         )
 
-    results = []
+    outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=420)
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+
+    if any(p.returncode != 0 for p in procs) and any(
+        _GLOO_ABORT in out for out in outs
+    ):
+        tail = next(
+            (l for out in outs for l in out.splitlines() if _GLOO_ABORT in l), ""
+        )
+        return None, tail.strip()[-300:]
+
+    results = []
+    for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
         lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
         assert lines, f"no RESULT line:\n{out[-3000:]}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
+    return results, None
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """Launch the 2-process world ONCE per module; per-feature tests below
+    assert against its published results (round-4 verdict, Weak #7: one
+    monolithic test made any failure an opaque single red)."""
+    ok, reason = _gloo_capability()
+    if not ok:
+        pytest.skip(f"CPU gloo collectives backend unavailable: {reason}")
+
+    # The transport abort the probe screens for can also strike a long
+    # worker non-deterministically on healthy-probing images; skip with the
+    # transport reason rather than erroring — any other failure still
+    # raises. No retry: a second ~2-minute attempt would blow the tier-1
+    # wall-clock budget exactly on the images where it is least likely to
+    # help.
+    results, reason = _launch_world_once(tmp_path_factory)
+    if results is None:
+        pytest.skip(f"CPU gloo collectives transport aborted mid-run: {reason}")
 
     r0, r1 = sorted(results, key=lambda r: r["rank"])
     return r0, r1
@@ -293,3 +420,29 @@ def test_embedding_distributed_bf16_step(world):
     assert r0["losses2"] == r1["losses2"]
     assert r0["losses2"][2] < r0["losses2"][0]
     assert r0["param_sum2"] == r1["param_sum2"]
+
+
+def test_owner_streaming_fold_spmd(world):
+    """Owner sharding's scatter_merge feeding the on-owner streaming fold
+    across two REAL processes: both agree on params and on the psum'd
+    drift gauge, the fold counter advanced between the two re-orths, and
+    truncated sides left real residual mass behind."""
+    r0, r1 = world
+    assert r0["owner_stream_param_sum"] == r1["owner_stream_param_sum"]
+    assert r0["owner_stream_residual"] == r1["owner_stream_residual"]
+    assert r0["owner_stream_residual"] > 0.0
+    assert r0["owner_stream_folds"] == r1["owner_stream_folds"] == 1
+    assert r0["owner_stream_reorths"] == 2  # boundaries 0 and 2
+    # the fold really ran: a third program beyond the two earlier models
+    # trained to different params
+    assert r0["owner_stream_param_sum"] != r0["param_sum"]
+
+
+def test_stream_snapshot_resume_across_processes(world):
+    """A streaming-solver snapshot written collectively by both processes
+    (orbax multi-process save) resumes bitwise in each process: the
+    continued run equals the uninterrupted one, and the manifest carries
+    the new stream state keys."""
+    r0, r1 = world
+    assert r0["stream_resume_bitwise"] and r1["stream_resume_bitwise"]
+    assert r0["stream_resume_param_sum"] == r1["stream_resume_param_sum"]

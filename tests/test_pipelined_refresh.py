@@ -155,6 +155,7 @@ def test_cadence_freq_growth_mid_interval():
 # numerics
 
 
+@pytest.mark.slow  # heaviest XLA compile in the file; tier-1 is wall-clock capped
 def test_chunks1_bitwise_parity_sharded():
     """eigh_chunks=1 is the monolithic path, bit for bit, on the 8-device
     mesh: same state pytree structure, same eigenbasis, same updates."""

@@ -75,12 +75,16 @@ def _env(world=8, axes=("data",), **kw):
 # ---------------------------------------------------------------------------
 
 
-def test_bigger_sides_engage_rsvd():
+def test_bigger_sides_engage_streaming():
+    """Where truncation wins, production now engages the streaming solver
+    (rsvd layout + per-step folds) rather than periodic rsvd — the refresh
+    spike disappears instead of shrinking."""
     env = _env(world=8, on_tpu=True)
     small, _, _ = resolve_profile("production", _SMALL_FACTS, env)
     big, report, _ = resolve_profile("production", _BIG_FACTS, env)
     assert small.solver == "eigh"
-    assert big.solver == "rsvd"
+    assert big.solver == "streaming"
+    assert big.stream_drift_threshold > 0.0
     assert report.rsvd_speedup >= 2.0
 
 
@@ -177,6 +181,12 @@ _LEVERS = {
     ),
     # budget with nothing to slip: refused by the constructor in EVERY env
     "staleness_bare": Plan(staleness_budget=1),
+    "streaming": Plan(solver="streaming"),
+    # the two streaming exclusions (constructor-enforced in every env)
+    "streaming+chunks": Plan(solver="streaming", eigh_chunks=2),
+    "streaming+staleness": Plan(
+        solver="streaming", staleness_budget=1, factor_comm_freq=2
+    ),
 }
 
 # environment features, each mapping to (PlanEnv kwargs, KFAC kwargs)
@@ -387,14 +397,14 @@ def test_profile_none_and_safe_are_inert():
 def test_profile_fills_only_default_levers():
     facts = _BIG_FACTS
     k = KFAC(damping=0.01, profile="production", profile_shapes=facts)
-    assert k.solver == "rsvd"  # plan filled it
+    assert k.solver == "streaming"  # plan filled it
     # explicit non-default lever wins over the plan's choice
     k2 = KFAC(
         damping=0.01, profile="production", profile_shapes=facts,
         solver_rank=64,
     )
     assert k2.solver_rank == 64
-    assert k2.plan is not None and k2.plan.solver == "rsvd"
+    assert k2.plan is not None and k2.plan.solver == "streaming"
 
 
 def test_profile_accepts_plain_shape_dict():
@@ -402,7 +412,7 @@ def test_profile_accepts_plain_shape_dict():
         damping=0.01, profile="production",
         profile_shapes={f"l{i}": (512, 4608) for i in range(6)},
     )
-    assert k.solver == "rsvd"
+    assert k.solver == "streaming"
 
 
 def test_profile_accepts_raw_params_pytree():

@@ -253,6 +253,7 @@ def test_sharded_rsvd_matches_replicated():
     )
 
 
+@pytest.mark.slow  # heaviest XLA compile in the file; tier-1 is wall-clock capped
 def test_chunked_deferred_flush_composes():
     """rsvd + chunked refresh + deferred factor flush on the mesh: the PR 4
     invariant (merge before chunk 0 reads the factors) holds, the interval

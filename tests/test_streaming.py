@@ -1,0 +1,343 @@
+"""Streaming low-rank curvature (``KFAC(solver="streaming")``).
+
+Pins the tentpole's four contracts (docs/PERF.md "Streaming curvature"):
+
+* **fold exactness** — the per-capture-step fold is a pure function of
+  ``(Q, F)``: matmul-only Rayleigh diagonals through the retained basis,
+  residual mass into ``rho`` with the ``residual_rho`` convention, >= 95%
+  spectrum mass on the power-law fixture, and bit-identical re-application
+  (no incremental error between re-orths).
+* **degeneration to rsvd** — at ``stream_drift_threshold=0`` with a
+  re-orth at every boundary the solver IS periodic ``solver="rsvd"``:
+  bitwise at ``kfac_update_freq=1``, and the drift-gated cadence is
+  structurally bounded by one re-orth per boundary.
+* **composition** — owner sharding and ``factor_comm_freq > 1`` parity vs
+  the replicated arm carrying the SAME deferral (both fold the identical
+  merged factor snapshots; mid-window snapshots differ across comm
+  schedules by design, exactly as tests/test_factor_sharding.py documents
+  for the dense/rsvd refresh).
+* **bookkeeping** — the two new state keys, the cadence's re-orth counter
+  round-trip, the ``expected_step_variants`` eigen-off twins, and the two
+  constructor refusals (planner rules ``streaming_vs_chunks`` /
+  ``streaming_vs_swap_slip``).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC, EigenRefreshCadence
+from kfac_pytorch_tpu.compile_cache import expected_step_variants
+from kfac_pytorch_tpu.ops import streaming as S
+from kfac_pytorch_tpu.ops.rsvd import bucketed_rsvd_eigh
+
+from test_preconditioner import _dense_params, _stats_for
+from test_pipelined_refresh import _apply, _assert_bitwise, _jit_update
+from test_rsvd_solver import _psd
+from test_factor_sharding import _assert_close, _run
+
+
+# ---------------------------------------------------------------------------
+# ops-level fold
+
+
+def test_fold_mass_on_power_law():
+    """Folding the factor back through its own rsvd basis recovers the
+    refresh's spectrum mass (>= 95% on the 256-dim power-law fixture) and
+    lands the refresh's own (d, rho) to f32 roundoff."""
+    rng = np.random.RandomState(0)
+    n, rank = 256, 32
+    a = _psd(rng, n, 1.0 / np.arange(1, n + 1) ** 2)
+    (q, d, rho), = bucketed_rsvd_eigh([a], rank=rank)
+    d_f, trace = S.fold_side(q, a, eps=1e-10)
+    mass = float(jnp.sum(d_f)) / float(trace)
+    assert mass >= 0.95, mass
+    np.testing.assert_allclose(np.asarray(d_f), np.asarray(d), rtol=1e-4,
+                               atol=1e-8)
+    rho_f = S.fold_rho(trace, d_f, n, rank)
+    np.testing.assert_allclose(float(rho_f), float(rho), rtol=1e-4)
+
+
+def test_fold_is_pure_in_q_and_f():
+    """No incremental error: folding the same (Q, F) twice is bitwise
+    identical — deferred-mode flushes land the same state per-step folding
+    would at that factor."""
+    rng = np.random.RandomState(1)
+    n, rank = 64, 8
+    a = _psd(rng, n, np.linspace(0.1, 2.0, n))
+    (q, _, _), = bucketed_rsvd_eigh([a], rank=rank)
+    d1, t1 = S.fold_side(q, a, eps=1e-10)
+    d2, t2 = S.fold_side(q, a, eps=1e-10)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+
+def test_fold_tracks_rotated_factor():
+    """When the factor drifts away from the retained basis, the folded
+    diagonals lose mass and fold_rho absorbs it — the quantity the drift
+    gauge watches."""
+    rng = np.random.RandomState(2)
+    n, rank = 64, 8
+    a = _psd(rng, n, 1.0 / np.arange(1, n + 1) ** 2)
+    (q, _, _), = bucketed_rsvd_eigh([a], rank=rank)
+    b = _psd(np.random.RandomState(3), n, 1.0 / np.arange(1, n + 1) ** 2)
+    d_a, t_a = S.fold_side(q, a, eps=1e-10)
+    d_b, t_b = S.fold_side(q, b, eps=1e-10)
+    miss_a = max(float(t_a) - float(jnp.sum(d_a)), 0.0) / float(t_a)
+    miss_b = max(float(t_b) - float(jnp.sum(d_b)), 0.0) / float(t_b)
+    assert miss_b > miss_a + 0.1, (miss_a, miss_b)
+    assert float(S.fold_rho(t_b, d_b, n, rank)) > 0.0
+
+
+def test_fold_diag_applies_eps_floor():
+    d = jnp.asarray([0.5, 1e-12, 2.0], jnp.float32)
+    out = S.fold_diag(None, d, eps=1e-6)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray([0.5, 0.0, 2.0], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# degeneration to periodic rsvd
+
+
+def _kfac_stream_pair(rng, **kw):
+    params = _dense_params(rng, (64, 64, 32))
+    a_c, g_s, grads = _stats_for(params, rng)
+    rsvd = KFAC(damping=0.003, solver="rsvd", solver_rank=16,
+                solver_auto_threshold=32, **kw)
+    strm = KFAC(damping=0.003, solver="streaming", solver_rank=16,
+                solver_auto_threshold=32, stream_drift_threshold=0.0, **kw)
+    return params, a_c, g_s, grads, rsvd, strm
+
+
+def test_reorth_every_step_bitwise_equals_rsvd():
+    """Re-orth at every step (the threshold=0, kfac_update_freq=1 degenerate
+    schedule): the fold never runs and every step IS the rsvd refresh —
+    bitwise-identical updates and eigen state."""
+    rng = np.random.RandomState(4)
+    params, a_c, g_s, grads, rsvd, strm = _kfac_stream_pair(rng)
+    s_r, s_s = rsvd.init(params), strm.init(params)
+    flags = {"update_factors": True, "update_eigen": True}
+    for _ in range(3):
+        g_r, s_r = _apply(rsvd, grads, s_r, a_c, g_s, flags)
+        g_s_out, s_s = _apply(strm, grads, s_s, a_c, g_s, flags)
+        _assert_bitwise(g_r, g_s_out, "updates")
+        for key in ("factors", "eigen", "eigen_stacked", "spectrum_mass"):
+            _assert_bitwise(s_r[key], s_s[key], key)
+    # after a re-orth the gauge carries the refresh's own residual
+    np.testing.assert_allclose(
+        float(s_s["stream_residual"]),
+        max(1.0 - float(s_s["spectrum_mass"]), 0.0), rtol=1e-6,
+    )
+    assert int(s_s["stream_fold_steps"]) == 0
+
+
+def test_threshold_zero_matches_periodic_rsvd_on_test_net():
+    """Acceptance gate: stream_drift_threshold=0 with a boundary every step
+    matches periodic solver='rsvd' on the 8-device test net."""
+    kw = {"solver_auto_threshold": 16, "solver_rank": 8,
+          "kfac_update_freq": 1}
+    s_r, _ = _run(dict(kw, solver="rsvd"))
+    s_s, _ = _run(dict(kw, solver="streaming", stream_drift_threshold=0.0))
+    _assert_close(s_r.params, s_s.params, rtol=1e-5, atol=1e-7)
+
+
+def test_mid_interval_fold_updates_d_keeps_q():
+    """Between boundaries the capture step folds: d/rho move with the EMA'd
+    factors, Q stays pinned to the last re-orth, and the fold counter and
+    drift gauge advance."""
+    rng = np.random.RandomState(5)
+    params, a_c, g_s, grads, _, strm = _kfac_stream_pair(rng)
+    s = strm.init(params)
+    _, s = _apply(strm, grads, s, a_c, g_s,
+                  {"update_factors": True, "update_eigen": True})
+    q_before = {n: e["QA"] for n, e in s["eigen"].items() if "QA" in e}
+    d_before = {n: e["dA"] for n, e in s["eigen"].items()}
+    # fresh stats → the EMA moves → the fold must move d
+    a_c2, g_s2, _ = _stats_for(params, np.random.RandomState(6))
+    _, s = _apply(strm, grads, s, a_c2, g_s2,
+                  {"update_factors": True, "update_eigen": False})
+    assert int(s["stream_fold_steps"]) == 1
+    assert float(s["stream_residual"]) >= 0.0
+    moved = 0
+    for n, e in s["eigen"].items():
+        if n in q_before:
+            _assert_bitwise(q_before[n], e["QA"], f"{n}: QA pinned")
+        moved += int(
+            not np.array_equal(np.asarray(d_before[n]), np.asarray(e["dA"]))
+        )
+    assert moved > 0
+
+
+# ---------------------------------------------------------------------------
+# drift-gated cadence
+
+
+def _cadence_run(kfac, steps, signal=None):
+    if signal is not None:
+        kfac.stream_drift_signal = signal
+    cad = EigenRefreshCadence(kfac)
+    return cad, [cad.flags_for_step(s) for s in range(steps)]
+
+
+def test_reorth_count_bounded_by_boundaries():
+    """Structural acceptance bound: re-orths happen ONLY at boundaries, so
+    the count is <= ceil(steps / kfac_update_freq) no matter what the drift
+    signal does — and between re-orths no step carries update_eigen (the
+    refresh-step p95/p50 == 1.0 property, as a flag schedule)."""
+    steps, freq = 13, 4
+    kfac = KFAC(damping=0.003, solver="streaming", kfac_update_freq=freq)
+    cad, flags = _cadence_run(kfac, steps, signal=lambda: 1.0)
+    reorths = [i for i, f in enumerate(flags) if f["update_eigen"]]
+    assert cad._reorth_count == len(reorths) <= math.ceil(steps / freq)
+    assert all(i % freq == 0 for i in reorths)
+
+
+def test_drift_below_threshold_skips_reorth():
+    """A quiet gauge skips every post-bootstrap boundary; a loud one
+    re-orths at each. The bootstrap re-orth is unconditional."""
+    steps, freq = 12, 4
+    quiet = KFAC(damping=0.003, solver="streaming", kfac_update_freq=freq,
+                 stream_drift_threshold=0.5)
+    cad_q, flags_q = _cadence_run(quiet, steps, signal=lambda: 0.1)
+    assert [f["update_eigen"] for f in flags_q].count(True) == 1
+    assert flags_q[0]["update_eigen"]  # bootstrap
+    assert cad_q._reorth_count == 1
+
+    loud = KFAC(damping=0.003, solver="streaming", kfac_update_freq=freq,
+                stream_drift_threshold=0.5)
+    cad_l, flags_l = _cadence_run(loud, steps, signal=lambda: 0.9)
+    assert [i for i, f in enumerate(flags_l) if f["update_eigen"]] == [0, 4, 8]
+    assert cad_l._reorth_count == 3
+
+
+def test_no_signal_reorths_every_boundary():
+    """No wired signal → the deterministic degenerate schedule (re-orth at
+    every boundary), identical to kfac_flags_for_step's streaming answer."""
+    kfac = KFAC(damping=0.003, solver="streaming", kfac_update_freq=3)
+    _, flags = _cadence_run(kfac, 9)
+    assert [i for i, f in enumerate(flags) if f["update_eigen"]] == [0, 3, 6]
+
+
+def test_cadence_state_dict_roundtrip():
+    """Elastic resume: reorth_count and the bootstrap bit survive the
+    state_dict round-trip, so a resumed cadence continues drift-gating
+    instead of re-bootstrapping."""
+    kfac = KFAC(damping=0.003, solver="streaming", kfac_update_freq=4,
+                stream_drift_threshold=0.5)
+    kfac.stream_drift_signal = lambda: 0.1
+    cad = EigenRefreshCadence(kfac)
+    for s in range(6):
+        cad.flags_for_step(s)
+    snap = cad.state_dict()
+    assert snap["reorth_count"] == 1
+
+    kfac2 = KFAC(damping=0.003, solver="streaming", kfac_update_freq=4,
+                 stream_drift_threshold=0.5)
+    kfac2.stream_drift_signal = lambda: 0.1
+    resumed = EigenRefreshCadence(kfac2)
+    resumed.load_state_dict(snap)
+    cont = [resumed.flags_for_step(s) for s in range(6, 12)]
+    ref = [cad.flags_for_step(s) for s in range(6, 12)]
+    assert cont == ref
+    # boundary 8 was skipped (quiet signal, already bootstrapped)
+    assert not cont[2]["update_eigen"]
+    assert resumed._reorth_count == 1
+
+
+# ---------------------------------------------------------------------------
+# state keys + compile budget
+
+
+def test_stream_state_keys():
+    rng = np.random.RandomState(7)
+    params = _dense_params(rng, (12, 16, 8))
+    strm = KFAC(damping=0.003, solver="streaming")
+    s = strm.init(params)
+    assert s["stream_residual"].dtype == jnp.float32
+    assert s["stream_residual"].shape == ()
+    assert s["stream_fold_steps"].dtype == jnp.int32
+    assert int(s["stream_fold_steps"]) == 0
+    for other in (KFAC(damping=0.003), KFAC(damping=0.003, solver="rsvd")):
+        st = other.init(params)
+        assert "stream_residual" not in st
+        assert "stream_fold_steps" not in st
+
+
+def test_expected_step_variants_covers_drift_gated_run():
+    """The variant budget covers a run with a wired signal: skipped
+    re-orths land on existing fold programs, never a fresh retrace."""
+    rng = np.random.RandomState(8)
+    params, a_c, g_s, grads, rsvd, strm = _kfac_stream_pair(
+        rng, fac_update_freq=1, kfac_update_freq=3)
+    assert expected_step_variants(strm) >= expected_step_variants(rsvd)
+    budget = expected_step_variants(strm)
+
+    sig = {"v": 1.0}
+    strm.stream_drift_signal = lambda: sig["v"]
+    cad = EigenRefreshCadence(strm)
+    step = _jit_update(strm)
+    state = strm.init(params)
+    for s in range(8):
+        fl = cad.flags_for_step(s)
+        _, state = step(grads, state, a_c, g_s,
+                        update_factors=fl["update_factors"],
+                        update_eigen=fl["update_eigen"])
+        sig["v"] = 0.0 if s < 4 else 1.0  # skip boundary 3, re-orth at 6
+    assert cad._reorth_count == 2
+    assert int(step._cache_size()) <= budget
+
+
+def test_streaming_refusals():
+    """Constructor enforcement of the planner rules streaming_vs_chunks and
+    streaming_vs_swap_slip, plus threshold validation."""
+    with pytest.raises(ValueError, match="streaming_vs_chunks"):
+        KFAC(solver="streaming", eigh_chunks=2)
+    with pytest.raises(ValueError, match="streaming_vs_swap_slip"):
+        KFAC(solver="streaming", staleness_budget=1, factor_comm_freq=2)
+    with pytest.raises(ValueError):
+        KFAC(solver="streaming", stream_drift_threshold=-0.1)
+    with pytest.raises(ValueError):
+        KFAC(solver="streaming", solver_rank=0)
+
+
+# ---------------------------------------------------------------------------
+# composition: owner sharding + deferred comm (8-device mesh)
+
+
+def test_owner_streaming_matches_replicated_per_step():
+    """Per-step folds on both arms (factor_comm_freq=1): the on-owner fold
+    over scatter_merged shards equals the replicated fold up to collective
+    reassociation (the fold recomputes d from the factors every step, so
+    f32 rounding differences compound where rsvd's frozen d would not —
+    hence the atol floor)."""
+    kw = {"solver": "streaming", "solver_auto_threshold": 16,
+          "solver_rank": 8, "stream_drift_threshold": 0.0}
+    s_rep, _ = _run(dict(kw))
+    s_own, _ = _run({**kw, "factor_sharding": "owner"})
+    _assert_close(s_rep.params, s_own.params, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        float(jax.device_get(s_rep.kfac_state["stream_residual"])),
+        float(jax.device_get(s_own.kfac_state["stream_residual"])),
+        rtol=1e-4,
+    )
+
+
+def test_owner_streaming_composes_with_deferred_comm():
+    """factor_comm_freq=2 against the replicated arm carrying the SAME
+    deferral: flush steps fall mid-interval (fac=1, comm=2, kfac=3 over 9
+    steps), so real mid-window folds run over merged factors on both arms
+    and the trajectories match at rtol 1e-6."""
+    kw = {"solver": "streaming", "solver_auto_threshold": 16,
+          "solver_rank": 8, "stream_drift_threshold": 0.0,
+          "factor_comm_freq": 2}
+    s_rep, k_rep = _run(dict(kw), steps=9)
+    assert k_rep.factor_comm.defer
+    s_own, _ = _run({**kw, "factor_sharding": "owner"}, steps=9)
+    # the deferred fold really ran mid-window on both arms
+    assert int(jax.device_get(s_rep.kfac_state["stream_fold_steps"])) > 0
+    assert int(jax.device_get(s_own.kfac_state["stream_fold_steps"])) > 0
+    _assert_close(s_rep.params, s_own.params, rtol=1e-6, atol=1e-6)
