@@ -242,8 +242,18 @@ def parse_args(argv=None):
                    help="let a deferred factor flush or a completed pending "
                         "eigen swap slip up to this many steps under "
                         "measured comm/compute pressure (needs "
-                        "--factor-comm-freq > 1 or --eigh-chunks > 1; 0 = "
-                        "never slip; watch the kfac/staleness_* gauges)")
+                        "--factor-comm-freq > 1, --eigh-chunks > 1 or "
+                        "--service-devices > 0; 0 = never slip; watch the "
+                        "kfac/staleness_* gauges)")
+    p.add_argument("--service-devices", type=int, default=0,
+                   help="carve this many devices out of the mesh as "
+                        "dedicated curvature workers (kfac_pytorch_tpu/"
+                        "service/): the eigen refresh leaves the training "
+                        "step entirely — factor snapshots publish at each "
+                        "--kfac-update-freq boundary, refreshed bases "
+                        "install between steps, --staleness-budget bounds "
+                        "the install slip (docs/SERVICE.md); 0 = inline "
+                        "refresh")
     p.add_argument("--profile", default=None,
                    choices=["safe", "memory", "production"],
                    help="resolve the K-FAC perf levers from a named planner "
@@ -279,7 +289,12 @@ def main(argv=None):
     )
 
     launch.initialize()  # multi-host wiring; no-op single-process
-    mesh = data_parallel_mesh()
+    if args.service_devices > 0:
+        from kfac_pytorch_tpu.parallel.mesh import split_service_mesh
+
+        mesh, service_workers = split_service_mesh(args.service_devices)
+    else:
+        mesh, service_workers = data_parallel_mesh(), ()
     world = mesh.devices.size
     n_proc = launch.size()
     accum = args.batches_per_allreduce
@@ -359,6 +374,7 @@ def main(argv=None):
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
+                service_devices=args.service_devices,
                 profile=profile,
                 profile_shapes=profile_shapes,
             )
@@ -620,6 +636,19 @@ def main(argv=None):
                 print(f"elastic: resumed from snapshot at step {step}")
     preempted = False
 
+    svc = None
+    if kfac is not None and args.service_devices > 0:
+        from kfac_pytorch_tpu.service import CurvatureService
+
+        svc = CurvatureService(
+            kfac, cadence, worker_devices=service_workers, supervisor=sup,
+        )
+        if launch.is_primary():
+            print(
+                f"curvature service: {len(service_workers)} worker "
+                f"device(s), staleness budget {svc.staleness_budget}"
+            )
+
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
@@ -676,6 +705,12 @@ def main(argv=None):
                 lr = lr_base * lr_factor(epoch + i / steps_per_epoch)
                 damping = kfac.hparams.damping if kfac else 0.0
                 flags = cadence.flags_for_step(step, epoch)
+                if svc is not None:
+                    # install the newest complete basis before the step
+                    # (blocks only at the staleness deadline)
+                    state = state.replace(
+                        kfac_state=svc.before_step(step, state.kfac_state)
+                    )
                 with tel.span("comm/host_to_device"):
                     batch = put_global_batch(mesh, (xb, yb), accum_steps=accum)
                 if flags.get("eigen_chunk") is not None:
@@ -692,6 +727,9 @@ def main(argv=None):
                         **flags
                     )
                     sp.block(metrics)
+                if svc is not None:
+                    # boundary steps publish the just-folded factor snapshot
+                    svc.after_step(step, state.kfac_state)
                 step += 1
                 pending.append(metrics)
                 if sup is not None and sup.on_step(step, lambda: state):

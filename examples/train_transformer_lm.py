@@ -187,8 +187,15 @@ def parse_args(argv=None):
                    help="let a deferred factor flush or a completed pending "
                         "eigen swap slip up to this many steps under "
                         "measured comm/compute pressure (needs "
-                        "--factor-comm-freq > 1 or --eigh-chunks > 1; 0 = "
-                        "never slip; watch the kfac/staleness_* gauges)")
+                        "--factor-comm-freq > 1, --eigh-chunks > 1 or "
+                        "--service-devices > 0; 0 = never slip; watch the "
+                        "kfac/staleness_* gauges)")
+    p.add_argument("--service-devices", type=int, default=0,
+                   help="carve this many devices out of the pure-DP mesh as "
+                        "dedicated curvature workers (kfac_pytorch_tpu/"
+                        "service/): the eigen refresh leaves the training "
+                        "step; bases install between steps at bounded "
+                        "staleness (docs/SERVICE.md); 0 = inline refresh")
     p.add_argument("--profile", default=None,
                    choices=["safe", "memory", "production"],
                    help="resolve the K-FAC perf levers from a named planner "
@@ -262,6 +269,7 @@ def main(argv=None):
         factor_sharding=args.factor_sharding,
         comm_overlap=args.comm_overlap,
         staleness_budget=args.staleness_budget,
+        service_devices=args.service_devices,
     )
     if sp > 1:
         lever_axes = ("data", "seq")
@@ -270,7 +278,8 @@ def main(argv=None):
     else:
         lever_axes = ("data",)
     lever_env = planner.PlanEnv(
-        world=int(devices.size),
+        # the carved curvature workers are not part of the training world
+        world=int(devices.size) - max(0, args.service_devices),
         # a REAL seq axis is what the owner/comm levers cannot ride; the
         # tensor axis is replicated-compute and passes pure_dp
         mesh_axes=lever_axes,
@@ -279,6 +288,7 @@ def main(argv=None):
         has_conv_layers=False,
         fac_update_freq=max(1, args.kfac_cov_update_freq),
         kfac_update_freq=max(1, args.kfac_update_freq),
+        service_devices=args.service_devices,
     )
     bad = planner.violations(cli_plan, lever_env)
     if bad:
@@ -290,6 +300,12 @@ def main(argv=None):
     # owner/comm levers require; sequence parallelism adds the seq axis;
     # --tensor-parallel builds the 2-D data×tensor mesh (replicated-compute
     # tensor axis, K-FAC collectives on 'data' only)
+    service_workers = ()
+    if args.service_devices > 0 and (sp > 1 or tp > 1):
+        raise SystemExit(
+            "--service-devices carves a pure data-parallel mesh; it does "
+            "not compose with --seq-parallel or --tensor-parallel"
+        )
     if sp > 1:
         mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
         batch_spec = P("data", "seq")
@@ -300,6 +316,15 @@ def main(argv=None):
         mesh = data_tensor_mesh(tp, devices=devices)
         batch_spec = P("data")
         dp = devices.size // tp
+    elif args.service_devices > 0:
+        from kfac_pytorch_tpu.parallel.mesh import split_service_mesh
+
+        mesh, service_workers = split_service_mesh(
+            args.service_devices, devices=list(devices.ravel())
+        )
+        devices = mesh.devices  # the training subset from here on
+        batch_spec = P("data")
+        dp = devices.size
     else:
         mesh = Mesh(devices, ("data",))
         batch_spec = P("data")
@@ -382,6 +407,7 @@ def main(argv=None):
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
+                service_devices=args.service_devices,
                 profile=profile,
                 profile_shapes=profile_shapes,
             )
@@ -561,6 +587,19 @@ def main(argv=None):
                 print(f"elastic: resumed from snapshot at step {step}")
     preempted = False
 
+    svc = None
+    if kfac is not None and args.service_devices > 0:
+        from kfac_pytorch_tpu.service import CurvatureService
+
+        svc = CurvatureService(
+            kfac, cadence, worker_devices=service_workers, supervisor=sup,
+        )
+        if launch.is_primary():
+            print(
+                f"curvature service: {len(service_workers)} worker "
+                f"device(s), staleness budget {svc.staleness_budget}"
+            )
+
     for epoch in range(resume_from_epoch, args.epochs):
         if kfac_sched:
             kfac_sched.step(epoch=epoch)
@@ -589,6 +628,11 @@ def main(argv=None):
                 if epoch == resume_from_epoch and i < resume_skip:
                     continue  # mid-epoch snapshot resume: keep i == step phase
                 flags = cadence.flags_for_step(step, epoch)
+                if svc is not None:
+                    # install the newest complete basis before the step
+                    state = state.replace(
+                        kfac_state=svc.before_step(step, state.kfac_state)
+                    )
                 if flags.get("eigen_chunk") is not None:
                     sp_t = tel.span("step/eigen_chunk")
                 elif not flags.get("update_factors"):
@@ -604,6 +648,9 @@ def main(argv=None):
                         **flags
                     )
                     sp_t.block(metrics)
+                if svc is not None:
+                    # boundary steps publish the just-folded factor snapshot
+                    svc.after_step(step, state.kfac_state)
                 step += 1
                 pending.append(metrics)
                 if sup is not None and sup.on_step(step, lambda: state):

@@ -136,7 +136,14 @@ def parse_args(argv=None):
                         "identical numerics)")
     p.add_argument("--staleness-budget", type=int, default=0,
                    help="bounded slip for deferred flushes / pending swaps "
-                        "(needs --factor-comm-freq > 1 or --eigh-chunks > 1)")
+                        "/ service basis installs (needs --factor-comm-freq "
+                        "> 1, --eigh-chunks > 1 or --service-devices > 0)")
+    p.add_argument("--service-devices", type=int, default=0,
+                   help="carve this many devices out as dedicated curvature "
+                        "workers (kfac_pytorch_tpu/service/): the eigen "
+                        "refresh leaves the training step; bases install "
+                        "between steps at bounded staleness "
+                        "(docs/SERVICE.md); 0 = inline refresh")
     p.add_argument("--profile", default=None,
                    choices=["safe", "memory", "production"],
                    help="resolve the K-FAC perf levers from a named planner "
@@ -184,6 +191,7 @@ def main(argv=None):
     kfac = None
     devices = np.asarray(jax.devices())
     mesh = None
+    service_workers = ()
     if use_kfac:
         layers = capture.discover_layers(model, tokens0, train=True)
         if not layers:
@@ -206,14 +214,17 @@ def main(argv=None):
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
+                service_devices=args.service_devices,
             )
             lever_env = planner.PlanEnv(
-                world=int(devices.size),
+                # carved curvature workers leave the training world
+                world=int(devices.size) - max(0, args.service_devices),
                 mesh_axes=("data",) if devices.size > 1 else (),
                 has_diag_a_layers=args.kfac_embedding,
                 has_conv_layers=False,
                 fac_update_freq=max(1, args.kfac_cov_update_freq),
                 kfac_update_freq=max(1, args.kfac_update_freq),
+                service_devices=args.service_devices,
             )
             bad = planner.violations(cli_plan, lever_env)
             if bad:
@@ -221,7 +232,13 @@ def main(argv=None):
                     "invalid K-FAC lever composition:\n"
                     + "\n".join(f"  [{r.name}] {r.message}" for r in bad)
                 )
-            if devices.size > 1:
+            if args.service_devices > 0:
+                from kfac_pytorch_tpu.parallel.mesh import split_service_mesh
+
+                mesh, service_workers = split_service_mesh(
+                    args.service_devices
+                )
+            elif devices.size > 1:
                 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
 
                 mesh = data_parallel_mesh()
@@ -246,6 +263,7 @@ def main(argv=None):
                 factor_sharding=args.factor_sharding,
                 comm_overlap=args.comm_overlap,
                 staleness_budget=args.staleness_budget,
+                service_devices=args.service_devices,
                 profile=args.profile,
                 profile_shapes=profile_shapes,
             )
@@ -367,6 +385,16 @@ def main(argv=None):
             print(f"elastic: resumed from snapshot at step {step}")
     preempted = False
 
+    svc = None
+    if kfac is not None and args.service_devices > 0:
+        from kfac_pytorch_tpu.service import CurvatureService
+
+        svc = CurvatureService(
+            kfac, cadence, worker_devices=service_workers, supervisor=sup,
+        )
+        print(f"curvature service: {len(service_workers)} worker device(s), "
+              f"staleness budget {svc.staleness_budget}")
+
     def fresh_carry():
         # zero carry for an epoch start, committed to the mesh so epoch
         # boundaries don't introduce a mixed committed/uncommitted input
@@ -396,11 +424,19 @@ def main(argv=None):
             if epoch == resume_from_epoch and i < resume_skip:
                 continue  # mid-epoch snapshot resume: keep i/rng == step phase
             flags = cadence.flags_for_step(step, epoch)
+            if svc is not None:
+                # install the newest complete basis before the step
+                state = state.replace(
+                    kfac_state=svc.before_step(step, state.kfac_state)
+                )
             state, carry, metrics = train_step(
                 state, (jnp.asarray(xb), jnp.asarray(yb)), carry, sub,
                 jnp.float32(lr), jnp.float32(kfac.hparams.damping if kfac else 0.0),
                 **flags,
             )
+            if svc is not None:
+                # boundary steps publish the just-folded factor snapshot
+                svc.after_step(step, state.kfac_state)
             step += 1
             n_steps += 1
             loss_m.update(jax.device_get(metrics["loss"]))

@@ -29,15 +29,17 @@ __all__ = [
     "capture",
     "elastic",
     "ops",
+    "service",
     "__version__",
 ]
 
 
 def __getattr__(name):
-    # the elastic runtime pulls in orbax; load it on first touch so plain
-    # `import kfac_pytorch_tpu` stays cheap for non-checkpointing users
-    if name == "elastic":
+    # the elastic runtime pulls in orbax, and the curvature service pulls
+    # in the worker/mailbox stack; load each on first touch so plain
+    # `import kfac_pytorch_tpu` stays cheap
+    if name in ("elastic", "service"):
         import importlib
 
-        return importlib.import_module("kfac_pytorch_tpu.elastic")
+        return importlib.import_module(f"kfac_pytorch_tpu.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

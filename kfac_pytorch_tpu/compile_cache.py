@@ -115,6 +115,7 @@ def expected_step_variants(kfac, plan=None, autotune_candidates: int = 0) -> int
                 getattr(plan, "stream_drift_threshold", 0.05)
             ),
             stream_drift_signal=None,
+            service_devices=int(getattr(plan, "service_devices", 0)),
         )
 
     hp = sim.hparams

@@ -26,6 +26,9 @@ touching the compiled program:
   under ``<save_dir>/heartbeats/``; ``kfac/host_liveness`` gauges how many
   hosts beat within the window. On shared storage this is the cheap
   cross-host health signal a pod scheduler (or a human) can watch.
+  Curvature-service worker hosts never advance the step counter, so they
+  beat on wall clock via :meth:`Supervisor.worker_beat` instead of the
+  step-keyed :meth:`on_step` path (docs/SERVICE.md).
 
 Multi-process runs force snapshots synchronous: the orbax write is a
 collective over processes, and driving a collective from a per-host
@@ -81,6 +84,7 @@ class Supervisor:
         self.async_snapshots = bool(async_snapshots) and jax.process_count() == 1
         self.fault_injector = fault_injector
         self.preempt_requested = False
+        self._last_worker_beat = 0.0
         self.last_snapshot_step: Optional[int] = None
         self.snapshot_durations_ms: list = []
         self._writer: Optional[threading.Thread] = None
@@ -269,6 +273,39 @@ class Supervisor:
         tmp = f"{path}.tmp"
         with open(tmp, "w") as fh:
             json.dump({"t": time.time(), "step": int(step)}, fh)
+        os.replace(tmp, path)
+
+    def worker_beat(
+        self, version: int = -1, min_interval_s: Optional[float] = None
+    ) -> None:
+        """Liveness beat for curvature-service workers.
+
+        :meth:`on_step` assumes every host advances the training step
+        counter, but a dedicated curvature worker never does — its whole
+        point is to stay off the training critical path — so a worker-host
+        beat keyed on steps would read as dead within one window. Workers
+        beat on wall clock instead (rate-limited; default a quarter of the
+        liveness window) and record the basis version they last published
+        in place of a step. :meth:`liveness` needs no change: it scans
+        every ``*.json`` beat for a fresh ``t``.
+        """
+        if min_interval_s is None:
+            min_interval_s = self.liveness_window_s / 4.0
+        now = time.time()
+        if now - self._last_worker_beat < float(min_interval_s):
+            return
+        self._last_worker_beat = now
+        path = os.path.join(
+            self.save_dir, _HEARTBEAT_DIR,
+            f"worker-{jax.process_index()}.json",
+        )
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(
+                {"t": now, "version": int(version),
+                 "role": "curvature-worker"}, fh,
+            )
         os.replace(tmp, path)
 
     def liveness(self) -> int:

@@ -62,6 +62,44 @@ def data_tensor_mesh(
     return Mesh(grid, (axis_name, tensor_axis_name))
 
 
+def split_service_mesh(
+    service_devices: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = "data",
+):
+    """Carve curvature-service workers out of the device set.
+
+    Returns ``(train_mesh, worker_devices)``: a 1-D data-parallel mesh over
+    the FIRST ``n - service_devices`` devices plus the tuple of carved
+    trailing devices the :class:`~kfac_pytorch_tpu.service.CurvatureWorker`
+    runs on. Trailing devices are carved so the training mesh keeps the
+    dense low-index prefix — the same contraction direction the elastic
+    ``replan`` row-remap uses when the training world shrinks, which is
+    what makes enabling the service equivalent to a planned shrink plus a
+    worker set rather than a third topology.
+
+    ``service_devices == 0`` degenerates to ``(data_parallel_mesh(...), ())``
+    so call sites can thread the lever through unconditionally. At least
+    one device must remain for training.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = int(service_devices)
+    if n < 0:
+        raise ValueError(f"service_devices must be >= 0, got {service_devices}")
+    if n >= len(devices):
+        raise ValueError(
+            f"service_devices={n} leaves no training devices "
+            f"(have {len(devices)})"
+        )
+    if n == 0:
+        return data_parallel_mesh(devices, axis_name), ()
+    train = devices[: len(devices) - n]
+    workers = tuple(devices[len(devices) - n :])
+    return Mesh(np.asarray(train), (axis_name,)), workers
+
+
 def data_axis_size(mesh: Mesh, axis_name: str = "data") -> int:
     """Replica count along the batch axis (the K-FAC ``world``)."""
     return int(mesh.shape[axis_name]) if axis_name in mesh.shape else 1

@@ -72,6 +72,15 @@ COMM_DEFER_FREQ = 10
 #: scatter/allgather restructuring saves too little memory to pay for
 #: losing replicated-state simplicity.
 OWNER_MIN_WORLD = 8
+#: the curvature service engages — given an operator-offered carve
+#: (``env.service_devices > 0``, devices already removed from the training
+#: mesh) — when one interval's DENSE refresh work exceeds this multiple of
+#: the training capacity the carved devices give up over the same interval
+#: (``service_devices/world · kfac_update_freq · precondition_cost``).
+#: Below the bar, the carve loses more capture throughput than the
+#: refresh spike it removes; an offered-but-unprofitable carve resolves
+#: with the service unengaged.
+SERVICE_MIN_REFRESH_RATIO = 3.0
 
 # eigh slot padding defaults (ops/eigh.py bucket_size defaults, as used
 # by the chunk planners in parallel/assignment.py)
@@ -212,6 +221,26 @@ def wire_bytes_f32(facts: ModelFacts) -> Tuple[int, int]:
     return sum(b.size for b in buckets) * 4, len(buckets)
 
 
+def service_carve_cost(facts: ModelFacts, env: PlanEnv) -> int:
+    """The curvature-service engagement bar, in MACs per refresh interval.
+
+    The training capacity the offered carve gives up — per-step
+    precondition work scaled by the carved device fraction and the
+    interval length — times :data:`SERVICE_MIN_REFRESH_RATIO`. 0 when no
+    carve is offered (or there is no multi-device mesh to carve from), so
+    ``dense refresh > bar > 0`` is the whole engagement test.
+    """
+    if env.service_devices <= 0 or not env.multi_device:
+        return 0
+    return int(
+        SERVICE_MIN_REFRESH_RATIO
+        * env.service_devices
+        * env.kfac_update_freq
+        * precondition_cost(facts)
+        / env.world
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class CostReport:
     """The numbers behind a resolved plan — what the snapshot lint pins
@@ -230,6 +259,12 @@ class CostReport:
     wire_bucket_count: int
     owner_bytes_local: Optional[int]
     owner_bytes_replicated: Optional[int]
+    # Curvature-service numbers (defaults keep pre-service callers and
+    # goldens constructible): the carve the resolved plan engages and the
+    # engagement bar the dense refresh was judged against (0 = no carve
+    # offered).
+    service_devices: int = 0
+    service_carve_cost: int = 0
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -240,38 +275,54 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     profitable, before :func:`fit_plan` drops what the env refuses."""
     sides = _dense_sides(facts)
     max_side = max(sides) if sides else 0
-
-    # solver: truncate when it actually shrinks the refresh enough. Where
-    # periodic rsvd pays off, streaming pays off strictly more: the same
-    # truncated layout, but the recurring refresh becomes a drift-gated
-    # re-orth while capture steps fold with matmuls only.
-    candidate = Plan(
-        solver="streaming",
-        solver_rank=RSVD_RANK,
-        solver_auto_threshold=RSVD_SIDE_THRESHOLD,
-        stream_drift_threshold=STREAM_DRIFT_THRESHOLD,
-    )
-    dense_cost = refresh_cost(facts, Plan())
-    rsvd_cost = refresh_cost(facts, candidate)
-    use_rsvd = (
-        max_side >= RSVD_SIDE_THRESHOLD
-        and rsvd_cost > 0
-        and dense_cost / rsvd_cost >= RSVD_MIN_SPEEDUP
-    )
-    plan = candidate if use_rsvd else Plan()
-
-    # chunks: spread the refresh spike until it is within budget of one
-    # step's precondition work (scheduler clamps k_eff to the refresh
-    # interval, so cap there too). Streaming has no recurring spike to
-    # spread (streaming_vs_chunks) — chunks stay 1.
     precond = precondition_cost(facts)
-    resolved_refresh = refresh_cost(facts, plan)
-    if precond > 0 and plan.solver != "streaming":
-        want = math.ceil(resolved_refresh / (CHUNK_SPIKE_BUDGET * precond))
-        chunks = max(1, min(want, MAX_CHUNKS, env.kfac_update_freq))
+    dense_cost = refresh_cost(facts, Plan())
+
+    # service: decided FIRST — when an operator-offered carve clears the
+    # engagement bar, the refresh leaves the training step entirely, which
+    # supersedes every in-step refresh lever below (solver truncation,
+    # chunk spreading, owner-sharded eigen state). The worker refreshes
+    # dense eigh on whole replicated factors (the service exclusions), and
+    # a one-step staleness budget licenses install slip.
+    carve_bar = service_carve_cost(facts, env)
+    service = env.service_devices if (
+        carve_bar > 0 and dense_cost > carve_bar
+    ) else 0
+
+    if service:
+        plan = Plan(service_devices=service, staleness_budget=1)
     else:
-        chunks = 1
-    plan = dataclasses.replace(plan, eigh_chunks=chunks)
+        # solver: truncate when it actually shrinks the refresh enough.
+        # Where periodic rsvd pays off, streaming pays off strictly more:
+        # the same truncated layout, but the recurring refresh becomes a
+        # drift-gated re-orth while capture steps fold with matmuls only.
+        candidate = Plan(
+            solver="streaming",
+            solver_rank=RSVD_RANK,
+            solver_auto_threshold=RSVD_SIDE_THRESHOLD,
+            stream_drift_threshold=STREAM_DRIFT_THRESHOLD,
+        )
+        rsvd_cost = refresh_cost(facts, candidate)
+        use_rsvd = (
+            max_side >= RSVD_SIDE_THRESHOLD
+            and rsvd_cost > 0
+            and dense_cost / rsvd_cost >= RSVD_MIN_SPEEDUP
+        )
+        plan = candidate if use_rsvd else Plan()
+
+        # chunks: spread the refresh spike until it is within budget of
+        # one step's precondition work (scheduler clamps k_eff to the
+        # refresh interval, so cap there too). Streaming has no recurring
+        # spike to spread (streaming_vs_chunks) — chunks stay 1.
+        resolved_refresh = refresh_cost(facts, plan)
+        if precond > 0 and plan.solver != "streaming":
+            want = math.ceil(
+                resolved_refresh / (CHUNK_SPIKE_BUDGET * precond)
+            )
+            chunks = max(1, min(want, MAX_CHUNKS, env.kfac_update_freq))
+        else:
+            chunks = 1
+        plan = dataclasses.replace(plan, eigh_chunks=chunks)
 
     # wire: compress when the exchange is payload-bound; defer when there
     # are enough capture steps per refresh to amortize over
@@ -289,8 +340,10 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
         )
 
     # placement: owner-shard the curvature state at scale (the shard world
-    # is the data axes only — tensor replicas hold identical rows)
-    if env.factor_world >= OWNER_MIN_WORLD:
+    # is the data axes only — tensor replicas hold identical rows). Not
+    # under service: the worker consumes whole replicated factors
+    # (service_vs_owner_sharding would drop the carve in fit_plan).
+    if env.factor_world >= OWNER_MIN_WORLD and not service:
         plan = dataclasses.replace(plan, factor_sharding="owner")
 
     # overlap: fuse the factor exchange into the gradient stream whenever
@@ -300,10 +353,13 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     # slip into (deferred flushes or a chunked refresh).
     if env.world > 1:
         plan = dataclasses.replace(plan, comm_overlap=True)
-        # streaming has no pending swap to slip (streaming_vs_swap_slip)
+        # streaming has no pending swap to slip (streaming_vs_swap_slip);
+        # service already carries its install-slip budget from above
         if (
-            plan.factor_comm_freq > 1 or plan.eigh_chunks > 1
-        ) and plan.solver != "streaming":
+            (plan.factor_comm_freq > 1 or plan.eigh_chunks > 1)
+            and plan.solver != "streaming"
+            and not service
+        ):
             plan = dataclasses.replace(plan, staleness_budget=1)
 
     # kernel: pin the fused capture kernels where they are fast paths —
@@ -420,4 +476,6 @@ def _report(facts: ModelFacts, env: PlanEnv, plan: Plan) -> CostReport:
         wire_bucket_count=buckets,
         owner_bytes_local=owner_local,
         owner_bytes_replicated=owner_repl,
+        service_devices=int(plan.service_devices),
+        service_carve_cost=service_carve_cost(facts, env),
     )

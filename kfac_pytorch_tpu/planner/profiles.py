@@ -47,6 +47,7 @@ LEVER_FIELDS = (
     "comm_overlap",
     "staleness_budget",
     "stream_drift_threshold",
+    "service_devices",
 )
 
 
@@ -74,6 +75,10 @@ class Plan:
     # default): drift-gauge level above which the cadence
     # re-orthonormalizes at a kfac_update_freq boundary.
     stream_drift_threshold: float = 0.05
+    # Decoupled curvature service: N devices carved out of the mesh as
+    # dedicated refresh workers (kfac_pytorch_tpu/service/). 0 = refresh
+    # stays in-step (bitwise-inert default).
+    service_devices: int = 0
 
     def kfac_kwargs(self) -> Dict[str, object]:
         """The KFAC constructor kwargs this plan pins."""
@@ -91,7 +96,7 @@ class Plan:
         out = []
         for f in ("eigh_chunks", "factor_kernel", "factor_comm_dtype",
                   "factor_comm_freq", "solver", "factor_sharding",
-                  "comm_overlap", "staleness_budget"):
+                  "comm_overlap", "staleness_budget", "service_devices"):
             if getattr(self, f) != getattr(default, f):
                 out.append(f)
         return tuple(out)
@@ -106,7 +111,8 @@ class Plan:
             raise ValueError(f"unknown Plan fields: {sorted(unknown)}")
         kwargs = dict(d)
         for f in ("eigh_chunks", "factor_comm_freq", "solver_rank",
-                  "solver_auto_threshold", "staleness_budget"):
+                  "solver_auto_threshold", "staleness_budget",
+                  "service_devices"):
             if f in kwargs:
                 kwargs[f] = int(kwargs[f])
         if "comm_overlap" in kwargs:
@@ -149,6 +155,7 @@ class Plan:
             "stream_drift_threshold": int(
                 round(self.stream_drift_threshold * self._DRIFT_SCALE)
             ),
+            "service_devices": self.service_devices,
         }
         return {k: np.asarray(v, np.int32) for k, v in enc.items()}
 
@@ -175,6 +182,8 @@ class Plan:
                 )
                 / cls._DRIFT_SCALE
             ),
+            # absent in pre-service checkpoints: refresh stays in-step
+            service_devices=g.get("service_devices", 0),
         )
 
     def describe(self) -> str:
@@ -209,6 +218,8 @@ class Plan:
             bits.append("comm_overlap=on")
         if "staleness_budget" in on:
             bits.append(f"staleness_budget={self.staleness_budget}")
+        if "service_devices" in on:
+            bits.append(f"service_devices={self.service_devices}")
         return "plan: " + " ".join(bits)
 
 
@@ -241,6 +252,11 @@ class PlanEnv:
     on_tpu: bool = False
     fac_update_freq: int = 10
     kfac_update_freq: int = 100
+    # The curvature-service carve the OPERATOR has offered (devices already
+    # removed from the training mesh by split_service_mesh) — env, not
+    # lever: the cost model may engage plan.service_devices only up to this
+    # offer, and never invents a carve the deployment did not make.
+    service_devices: int = 0
 
     @property
     def multi_device(self) -> bool:
@@ -445,22 +461,87 @@ RULES: Tuple[Rule, ...] = (
                 "re-orthonormalizations land in place on drift boundaries, "
                 "so a staleness_budget would silently mean nothing",
     ),
+    # Curvature-service exclusions (service/ — refresh runs on carved
+    # workers, out of the training step). Environment conflicts shed the
+    # service; the chunk conflict sheds the chunks instead (the in-step
+    # spike eigh_chunks spreads no longer exists once the service owns the
+    # refresh). BEFORE staleness_requires_slack: service counts as slack
+    # there, so a plan that loses the service here must be re-judged.
+    Rule(
+        name="service_vs_inverse",
+        applies=lambda p: p.service_devices > 0,
+        conflicts=lambda p, e: e.precond_method == "inverse",
+        drop=("service_devices",),
+        enforced_by="constructor",
+        message="service_devices > 0 publishes factor snapshots to workers "
+                "that refresh an eigenbasis; precond_method='inverse' "
+                "refreshes ~30x-cheaper Cholesky inverses in-step — no "
+                "refresh spike worth a carve",
+    ),
+    Rule(
+        name="service_vs_streaming",
+        applies=lambda p: p.service_devices > 0,
+        conflicts=lambda p, e: p.solver == "streaming",
+        drop=("service_devices",),
+        enforced_by="constructor",
+        message="service_devices > 0 moves the periodic refresh to "
+                "dedicated workers; solver='streaming' already replaced it "
+                "with a per-step in-graph fold that cannot leave the "
+                "training program — pick one refresh-elimination scheme",
+    ),
+    Rule(
+        name="service_vs_chunks",
+        applies=lambda p: p.service_devices > 0,
+        conflicts=lambda p, e: p.eigh_chunks > 1,
+        drop=("eigh_chunks",),
+        enforced_by="constructor",
+        message="service_devices > 0 removes the refresh from the training "
+                "step entirely; eigh_chunks > 1 spreads an in-step refresh "
+                "spike that no longer exists",
+    ),
+    Rule(
+        name="service_vs_diag_blocks",
+        applies=lambda p: p.service_devices > 0,
+        conflicts=lambda p, e: e.diag_blocks > 1,
+        drop=("service_devices",),
+        enforced_by="constructor",
+        message="service_devices > 0 runs the worker refresh on whole "
+                "factors; diag_blocks > 1 needs the trainer-side conv "
+                "layout the published snapshot does not carry",
+    ),
+    Rule(
+        name="service_vs_owner_sharding",
+        applies=lambda p: p.service_devices > 0,
+        # owner sharding on a single-device mesh degrades to replicated
+        # (owner_requires_devices) before the service check sees it
+        conflicts=lambda p, e: p.factor_sharding == "owner"
+        and e.factor_world > 1,
+        drop=("service_devices",),
+        enforced_by="constructor",
+        message="service_devices > 0 publishes full replicated factor "
+                "snapshots and installs full replicated bases; "
+                "factor_sharding='owner' keeps per-owner shards that would "
+                "have to gather through the mailbox every boundary",
+    ),
     # Last on purpose: its conflict is plan-internal, so it must see the
     # plan AFTER every rule above has cleared levers — a fitted plan that
-    # lost its deferral/chunking slack must lose the budget too, or the
-    # constructor would refuse the fit_plan output.
+    # lost its deferral/chunking/service slack must lose the budget too,
+    # or the constructor would refuse the fit_plan output.
     Rule(
         name="staleness_requires_slack",
         applies=lambda p: p.staleness_budget > 0,
         conflicts=lambda p, e: not (
             p.factor_comm_freq > 1 or p.eigh_chunks > 1
+            or p.service_devices > 0
         ),
         drop=("staleness_budget",),
         enforced_by="constructor",
         message="staleness_budget > 0 bounds how far a deferred factor "
-                "flush or a pending eigen swap may slip, and this "
-                "configuration has neither: enable factor_comm_freq > 1 "
-                "(deferred flushes) or eigh_chunks > 1 (pending swaps)",
+                "flush, a pending eigen swap, or a service basis install "
+                "may slip, and this configuration has none of them: enable "
+                "factor_comm_freq > 1 (deferred flushes), eigh_chunks > 1 "
+                "(pending swaps), or service_devices > 0 (curvature "
+                "service)",
     ),
 )
 

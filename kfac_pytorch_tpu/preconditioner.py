@@ -154,6 +154,7 @@ class KFAC:
         comm_overlap: bool = False,
         staleness_budget: int = 0,
         stream_drift_threshold: float = 0.05,
+        service_devices: int = 0,
         profile: Optional[Any] = None,
         profile_shapes: Optional[Any] = None,
     ):
@@ -326,6 +327,10 @@ class KFAC:
                 on_tpu=jax.default_backend() == "tpu",
                 fac_update_freq=fac_update_freq,
                 kfac_update_freq=kfac_update_freq,
+                # the curvature-service carve the operator has OFFERED (the
+                # devices already removed from this mesh by
+                # split_service_mesh); the cost model decides engagement
+                service_devices=int(service_devices),
             )
             if isinstance(profile, _planner.Plan):
                 # An explicit plan must be valid as given (refusals raise
@@ -353,6 +358,7 @@ class KFAC:
                 "comm_overlap": comm_overlap,
                 "staleness_budget": staleness_budget,
                 "stream_drift_threshold": stream_drift_threshold,
+                "service_devices": service_devices,
             }
             for field, value in plan.kfac_kwargs().items():
                 if levers[field] == getattr(plan_defaults, field):
@@ -368,6 +374,7 @@ class KFAC:
             comm_overlap = levers["comm_overlap"]
             staleness_budget = levers["staleness_budget"]
             stream_drift_threshold = levers["stream_drift_threshold"]
+            service_devices = levers["service_devices"]
             self.plan = plan
             self.plan_dropped = tuple(dropped)
             self.plan_report = report
@@ -535,6 +542,62 @@ class KFAC:
                 factor_sharding = "replicated"
         self.factor_sharding = factor_sharding
         self._shard_plans: Dict[Any, Any] = {}
+        # Decoupled curvature service (kfac_pytorch_tpu/service/):
+        # service_devices=N declares that N dedicated curvature workers were
+        # carved OUT of the device set (split_service_mesh) and run the
+        # eigen refresh out-of-band — this KFAC's mesh is the TRAINING
+        # submesh and never sees them. In-step consequences: update()
+        # structurally refuses every refresh flag (update_eigen /
+        # eigen_chunk / swap_eigen), which is what pins the training-step
+        # HLO to zero eigendecompositions; refreshed bases arrive via
+        # service.ServiceClient.install between steps. The exclusions below
+        # mirror the planner validity rules of the same names.
+        _validate(
+            "service_devices",
+            isinstance(service_devices, int) and service_devices >= 0,
+            service_devices,
+        )
+        if service_devices > 0:
+            if precond_method == "inverse":
+                raise ValueError(
+                    "service_devices > 0 publishes factor snapshots to "
+                    "workers that refresh an EIGENBASIS; precond_method="
+                    "'inverse' refreshes ~30x-cheaper Cholesky inverses "
+                    "in-step — there is no refresh spike worth a carve "
+                    "(planner rule service_vs_inverse)"
+                )
+            if solver == "streaming":
+                raise ValueError(
+                    "service_devices > 0 moves the periodic refresh to "
+                    "dedicated workers; solver='streaming' already replaced "
+                    "it with a per-step in-graph fold that cannot leave the "
+                    "training program — pick one refresh-elimination scheme "
+                    "(planner rule service_vs_streaming)"
+                )
+            if eigh_chunks > 1:
+                raise ValueError(
+                    "service_devices > 0 removes the refresh from the "
+                    "training step entirely; eigh_chunks > 1 spreads an "
+                    "in-step refresh spike that no longer exists — leave "
+                    "eigh_chunks=1 (planner rule service_vs_chunks)"
+                )
+            if diag_blocks != 1:
+                raise ValueError(
+                    "service_devices > 0 runs the worker refresh on whole "
+                    "factors; diag_blocks > 1 needs the trainer-side conv "
+                    "layout the published snapshot does not carry — leave "
+                    "diag_blocks=1 (planner rule service_vs_diag_blocks)"
+                )
+            if factor_sharding == "owner":
+                raise ValueError(
+                    "service_devices > 0 publishes full replicated factor "
+                    "snapshots and installs full replicated bases; "
+                    "factor_sharding='owner' keeps per-owner shards that "
+                    "would have to gather through the mailbox every "
+                    "boundary — run the service with replicated sharding "
+                    "(planner rule service_vs_owner_sharding)"
+                )
+        self.service_devices = int(service_devices)
         # Stability telemetry (costs two scalars of state + O(layers) mins):
         # ν — the KL trust-region coefficient actually applied each step
         # (kfac_preconditioner.py:320-326) — and the minimum damped
@@ -635,13 +698,16 @@ class KFAC:
             isinstance(staleness_budget, int) and staleness_budget >= 0,
             staleness_budget,
         )
-        if staleness_budget > 0 and not (factor_comm_freq > 1 or eigh_chunks > 1):
+        if staleness_budget > 0 and not (
+            factor_comm_freq > 1 or eigh_chunks > 1 or service_devices > 0
+        ):
             raise ValueError(
                 "staleness_budget > 0 bounds how far a deferred factor "
-                "flush or a pending eigen swap may slip, and this "
-                "configuration has neither: enable factor_comm_freq > 1 "
-                "(deferred reduction) or eigh_chunks > 1 (pipelined "
-                "refresh), or leave staleness_budget=0"
+                "flush, a pending eigen swap, or a service basis install "
+                "may slip, and this configuration has none of them: enable "
+                "factor_comm_freq > 1 (deferred reduction), eigh_chunks > 1 "
+                "(pipelined refresh), or service_devices > 0 (curvature "
+                "service), or leave staleness_budget=0"
             )
         self.staleness_budget = int(staleness_budget)
         # Host-side comm/compute pressure source for the slip decision:
@@ -1356,6 +1422,18 @@ class KFAC:
             )
         if damping is None:
             damping = self.hparams.damping
+        if self.service_devices > 0 and (
+            update_eigen or eigen_chunk is not None or swap_eigen
+        ):
+            # This refusal IS the zero-eigh training-HLO guarantee the
+            # service mode advertises (scripts/check_service_hlo.py): no
+            # flag combination can trace a refresh into the training step.
+            raise ValueError(
+                "service_devices > 0 delegates the curvature refresh to "
+                "dedicated workers — the training step must never run "
+                "update_eigen/eigen_chunk/swap_eigen; refreshed bases "
+                "arrive via service.ServiceClient.install between steps"
+            )
         if eigen_chunk is not None:
             if self.eigh_chunks <= 1:
                 raise ValueError(

@@ -193,6 +193,14 @@ class EigenRefreshCadence:
         # Streaming-solver bookkeeping (solver="streaming" only):
         self._reorth_count = 0  # re-orthonormalizations so far (gauge)
         self._stream_signal: Optional[float] = None  # last drift read
+        # Curvature-service bookkeeping (service_devices > 0 only): the
+        # version/step of the last installed published basis and how many
+        # steps past the staleness-0 ideal it landed. Written by
+        # note_basis_installed (the ServiceClient install path); carried in
+        # state_dict so a split-role resume keeps its staleness accounting.
+        self._basis_version = -1
+        self._basis_installed_step: Optional[int] = None
+        self._basis_slip = 0
 
     def state_dict(self) -> dict:
         """JSON-serializable snapshot of the host-side interval state.
@@ -219,6 +227,9 @@ class EigenRefreshCadence:
             "flush_slip": self._flush_slip,
             "since_flush": self._since_flush,
             "reorth_count": self._reorth_count,
+            "basis_version": self._basis_version,
+            "basis_installed_step": self._basis_installed_step,
+            "basis_slip": self._basis_slip,
         }
 
     def load_state_dict(self, d: dict) -> None:
@@ -235,6 +246,28 @@ class EigenRefreshCadence:
         self._flush_slip = int(d.get("flush_slip", 0))
         self._since_flush = int(d.get("since_flush", 0))
         self._reorth_count = int(d.get("reorth_count", 0))
+        self._basis_version = int(d.get("basis_version", -1))
+        bis = d.get("basis_installed_step")
+        self._basis_installed_step = None if bis is None else int(bis)
+        self._basis_slip = int(d.get("basis_slip", 0))
+
+    def note_basis_installed(
+        self, version: int, step: int, slip: int = 0
+    ) -> None:
+        """Record a curvature-service basis install (service mode only).
+
+        Called by ``service.ServiceClient.install`` when a published
+        eigenbasis is swapped into KFAC state before ``step`` runs. The
+        install IS this mode's refresh event: it resets the basis-age
+        clock the ``kfac/eigen_basis_age_steps`` gauge reads, and ``slip``
+        (steps past the staleness-0 ideal; bounded by ``staleness_budget``)
+        feeds ``kfac/basis_staleness_steps``.
+        """
+        self._basis_version = int(version)
+        self._basis_installed_step = int(step)
+        self._basis_slip = int(slip)
+        self._last_refresh_step = int(step)
+        self._bootstrapped = True
 
     def _pressure(self) -> float:
         """The measured comm/compute ratio from the trainer-wired signal;
@@ -266,7 +299,17 @@ class EigenRefreshCadence:
         # always lands before the next refresh window opens
         swap_allowance = min(budget, hp.kfac_update_freq - k_eff)
         streaming = getattr(self.kfac, "solver", "eigh") == "streaming"
-        if streaming:
+        service = int(getattr(self.kfac, "service_devices", 0) or 0) > 0
+        if service:
+            # Decoupled curvature service: NO refresh flag ever fires —
+            # dedicated workers refresh out-of-band and the trainer-side
+            # ServiceClient installs published bases between steps
+            # (note_basis_installed records each install). Only capture
+            # remains in-step; the deferred-flush block below still runs,
+            # forced at every boundary so the published factor snapshot is
+            # always globally merged.
+            pass
+        elif streaming:
             # Degenerate streaming cadence: re-orth decisions only at
             # boundaries, gated on the wired drift signal. The constructor
             # refuses chunks/staleness with this solver, so none of the
@@ -346,10 +389,13 @@ class EigenRefreshCadence:
             # a skipped re-orth still folds there, and the fold must read
             # globally-merged factors — keeping the flag a pure function of
             # the step schedule (never of the drift signal's verdict).
+            # Service mode forces the same boundary flush: the factor
+            # snapshot published to the curvature workers right after a
+            # boundary step must be the globally-merged statistics.
             forced = (
                 flags["update_eigen"]
                 or chunk == 0
-                or (streaming and boundary)
+                or ((streaming or service) and boundary)
             )
             due = flags["update_factors"] and (
                 (step // hp.fac_update_freq) % comm.comm_freq == 0
@@ -420,4 +466,13 @@ class EigenRefreshCadence:
             )
             tel.set_gauge("kfac/stream_reorth_count", self._reorth_count)
             tel.set_gauge("kfac/stream_basis_age_steps", age)
+        if service:
+            # Service-mode gauges: the carved worker count, the version of
+            # the basis currently preconditioning, and how late (in steps,
+            # vs the staleness-0 ideal) that basis was installed.
+            tel.set_gauge(
+                "kfac/service_worker_count", int(self.kfac.service_devices)
+            )
+            tel.set_gauge("kfac/basis_version", self._basis_version)
+            tel.set_gauge("kfac/basis_staleness_steps", self._basis_slip)
         return flags

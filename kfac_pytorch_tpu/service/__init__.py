@@ -1,0 +1,40 @@
+"""Decoupled curvature service: refresh off the training critical path.
+
+Every earlier lever (chunking, overlap, slip, rsvd, streaming) shrinks or
+hides the curvature refresh *inside* the training step; this package
+removes it. A device subset carved from the mesh (``split_service_mesh``)
+— or a spare host — runs the eigen refresh continuously against published
+factor snapshots and publishes eigenbases back at bounded staleness, so
+training steps contain only capture + precondition + apply and the
+refresh-spike term vanishes from the step-time distribution (docs/SERVICE.md).
+
+Roles and flow::
+
+    trainer (train mesh)                 worker (carved devices / spare host)
+    --------------------                 --------------------------------
+    step, EMA factors
+    publish factors v ---[factors mailbox]---> refresh (eigh/rsvd)
+    install basis v  <----[basis mailbox]----- publish basis v
+    step, step, ...
+
+Enable with ``KFAC(service_devices=N, mesh=train_mesh, ...)`` where
+``train_mesh`` is the training submesh from ``split_service_mesh(N)`` —
+the KFAC instance never sees the worker devices; its refusal to accept
+``update_eigen`` under service mode is what pins the training-step HLO to
+zero eigendecompositions (scripts/check_service_hlo.py).
+"""
+
+from kfac_pytorch_tpu.parallel.mesh import split_service_mesh
+from kfac_pytorch_tpu.service.client import CurvatureService, ServiceClient
+from kfac_pytorch_tpu.service.mailbox import DeviceMailbox, HostMailbox
+from kfac_pytorch_tpu.service.worker import SCALARS_KEY, CurvatureWorker
+
+__all__ = [
+    "CurvatureService",
+    "CurvatureWorker",
+    "DeviceMailbox",
+    "HostMailbox",
+    "SCALARS_KEY",
+    "ServiceClient",
+    "split_service_mesh",
+]

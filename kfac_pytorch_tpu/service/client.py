@@ -1,0 +1,275 @@
+"""Trainer-side curvature-service client: publish factors, install bases.
+
+Two layers:
+
+* :class:`ServiceClient` — the install primitive: takes a published basis
+  payload and splices it into KFAC state exactly where the inline refresh
+  would have left it (``split_eigen_state`` → ``eigen``/``eigen_stacked``
+  (+ ``spectrum_mass``), replicated onto the training mesh).
+* :class:`CurvatureService` — the loop facade the example trainers and
+  bench use::
+
+      svc = CurvatureService(kfac, cadence, worker_devices=workers)
+      for step in range(steps):
+          state = svc.before_step(step, state)     # install newest basis
+          loss, state = train_step(...)            # capture+precond only
+          svc.after_step(step, state)              # publish at boundaries
+
+  ``after_step`` publishes a factor snapshot at every refresh boundary
+  (``step % kfac_update_freq == 0``, after the boundary step's EMA has
+  folded in) and kicks the worker; ``before_step`` installs the newest
+  complete basis before the next step begins. The staleness guarantee:
+  with ``staleness_budget`` S, the basis published for boundary step s is
+  installed no later than the start of step ``s + 1 + S`` — the client
+  slips (trains on the old basis) while the worker is still computing, and
+  *blocks* at the deadline rather than exceed the budget (docs/SERVICE.md).
+  S=0 therefore blocks every boundary until the fresh basis lands, which
+  is the configuration the inline-parity acceptance test pins.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.ops import precondition as precond_ops
+from kfac_pytorch_tpu.service.mailbox import DeviceMailbox, HostMailbox
+from kfac_pytorch_tpu.service.worker import SCALARS_KEY, CurvatureWorker
+
+KFACState = Dict[str, Any]
+
+
+class ServiceClient:
+    """Installs published eigenbases into trainer-side KFAC state."""
+
+    def __init__(self, kfac, cadence=None):
+        self.kfac = kfac
+        self.cadence = cadence
+        self.installed_version = -1
+        self.installed_step = -1
+
+    def install(
+        self,
+        state: KFACState,
+        payload: Dict[str, Dict[str, Any]],
+        version: int,
+        step: int,
+        slip: int = 0,
+    ) -> KFACState:
+        """New state with the published basis swapped in.
+
+        The payload is the worker's full per-layer eigen dict; the
+        singles/stacked split happens here (trainer side) so the mailbox
+        carries the plain per-layer form both transports can serialize.
+        Dtypes arrive as published (Q in ``eigen_dtype``, eigenvalues f32),
+        so the installed state is bit-identical to the worker's output.
+        """
+        entries = {
+            n: {k: jnp.asarray(v) for k, v in e.items()}
+            for n, e in payload.items()
+            if n != SCALARS_KEY
+        }
+        eigen, stacked = precond_ops.split_eigen_state(entries)
+        new_state = dict(state)
+        new_state["eigen"] = eigen
+        new_state["eigen_stacked"] = stacked
+        scalars = payload.get(SCALARS_KEY) or {}
+        if "spectrum_mass" in scalars and "spectrum_mass" in state:
+            new_state["spectrum_mass"] = jnp.asarray(
+                scalars["spectrum_mass"], jnp.float32
+            )
+        if self.kfac.mesh is not None:
+            # Replicate onto the TRAINING mesh explicitly — the worker
+            # computed on its carved device(s), and the next jitted step
+            # must not start with eigen leaves living off-mesh.
+            full = NamedSharding(self.kfac.mesh, P())
+            keys = ["eigen", "eigen_stacked"]
+            if "spectrum_mass" in scalars and "spectrum_mass" in state:
+                keys.append("spectrum_mass")
+            for key in keys:
+                new_state[key] = jax.device_put(
+                    new_state[key],
+                    jax.tree_util.tree_map(lambda _: full, new_state[key]),
+                )
+        self.installed_version = int(version)
+        self.installed_step = int(step)
+        if self.cadence is not None and hasattr(
+            self.cadence, "note_basis_installed"
+        ):
+            self.cadence.note_basis_installed(
+                version=version, step=step, slip=slip
+            )
+        else:
+            tel = get_telemetry()
+            tel.set_gauge("kfac/basis_version", int(version))
+            tel.set_gauge("kfac/basis_staleness_steps", int(slip))
+        return new_state
+
+
+class CurvatureService:
+    """Single-process service facade: mailboxes + worker + install loop.
+
+    The deployment-shape switch is ``mailbox_dir``: ``None`` uses
+    in-memory :class:`DeviceMailbox` pairs (shared-pod layout — trainer
+    and worker are device subsets of one process); a path uses
+    :class:`HostMailbox` ringbuffers (spare-host layout — a separate
+    worker process drives :meth:`CurvatureWorker.serve` against the same
+    directory, and ``run_worker=False`` here). ``tenant`` namespaces the
+    mailboxes so one worker fleet can serve several training jobs from
+    one root (multi-tenant sketch in docs/SERVICE.md).
+    """
+
+    def __init__(
+        self,
+        kfac,
+        cadence=None,
+        worker_devices: Sequence[Any] = (),
+        supervisor=None,
+        mailbox_dir: Optional[str] = None,
+        tenant: str = "job0",
+        run_worker: bool = True,
+        async_worker: bool = True,
+        staleness_budget: Optional[int] = None,
+        timeout_s: float = 300.0,
+    ):
+        if int(getattr(kfac, "service_devices", 0) or 0) <= 0:
+            raise ValueError(
+                "CurvatureService requires a KFAC configured with "
+                "service_devices > 0"
+            )
+        self.kfac = kfac
+        self.cadence = cadence
+        if mailbox_dir is not None:
+            self.factors_box = HostMailbox(mailbox_dir, f"{tenant}-factors")
+            self.basis_box = HostMailbox(mailbox_dir, f"{tenant}-basis")
+        else:
+            self.factors_box = DeviceMailbox(f"{tenant}-factors")
+            self.basis_box = DeviceMailbox(f"{tenant}-basis")
+        self.client = ServiceClient(kfac, cadence)
+        self.worker: Optional[CurvatureWorker] = None
+        if run_worker:
+            self.worker = CurvatureWorker(
+                kfac,
+                self.factors_box,
+                self.basis_box,
+                device=(worker_devices[0] if worker_devices else None),
+                supervisor=supervisor,
+            )
+        self.async_worker = bool(async_worker)
+        self.staleness_budget = (
+            int(kfac.staleness_budget)
+            if staleness_budget is None
+            else int(staleness_budget)
+        )
+        self.timeout_s = float(timeout_s)
+        self.published_version = 0
+        self.published_step = -1
+        self._worker_thread: Optional[threading.Thread] = None
+        self._worker_error: Optional[BaseException] = None
+        get_telemetry().set_gauge(
+            "kfac/service_worker_count",
+            len(worker_devices) if worker_devices else 1,
+        )
+
+    # -- loop hooks ----------------------------------------------------
+
+    def before_step(self, step: int, state: KFACState) -> KFACState:
+        """Install the newest complete basis; block only at the staleness
+        deadline (see class docstring for the guarantee)."""
+        if (
+            self.published_step >= 0
+            and self.published_version > self.client.installed_version
+        ):
+            deadline = self.published_step + 1 + self.staleness_budget
+            if self.basis_box.latest_version() < self.published_version:
+                if step >= deadline:
+                    self._join_worker()
+                    self.basis_box.wait_for(
+                        self.published_version, timeout_s=self.timeout_s
+                    )
+            got = self.basis_box.latest()
+            if got is not None and got[0] > self.client.installed_version:
+                version, payload, _meta = got
+                # slip: steps late vs the staleness-0 ideal of "installed
+                # before the step after its publish boundary"
+                slip = max(0, step - (self.published_step + 1))
+                state = self.client.install(
+                    state, payload, version, step, slip=slip
+                )
+        return state
+
+    def after_step(self, step: int, state: KFACState) -> None:
+        """Publish a factor snapshot at refresh boundaries and kick the
+        worker. On a shared pod the snapshot is an async device-side copy
+        into non-donatable buffers (see :meth:`_snapshot_factors`) — the
+        publish returns before the copy lands and the worker's eigh
+        dispatch overlaps the next training step; the HostMailbox
+        transport copies to host inside publish instead."""
+        freq = int(self.kfac.hparams.kfac_update_freq)
+        if step % freq != 0:
+            return
+        t0 = time.monotonic()
+        self.published_version += 1
+        self.published_step = step
+        self.factors_box.publish(
+            self.published_version,
+            self._snapshot_factors(state),
+            meta={"step": int(step)},
+        )
+        get_telemetry().observe(
+            "kfac/service_publish_ms", (time.monotonic() - t0) * 1000.0
+        )
+        if self.worker is not None:
+            if self.async_worker:
+                self._join_worker()
+                self._worker_thread = threading.Thread(
+                    target=self._worker_step_guarded, daemon=True
+                )
+                self._worker_thread.start()
+            else:
+                self.worker.step(timeout_s=self.timeout_s)
+
+    def _snapshot_factors(self, state: KFACState):
+        """Publishable factor snapshot in non-donatable buffers.
+
+        The trainer's jitted step typically DONATES its state, so the live
+        factor arrays a pointer-handoff publish would still reference get
+        deleted by the next step's dispatch before an async worker ever
+        reads them. Re-home the snapshot: straight onto the worker device
+        when one is carved (where the refresh wants it anyway — the
+        worker-side device_put becomes a no-op), else a same-placement
+        copy. The HostMailbox transport copies to host inside publish, so
+        it needs neither.
+        """
+        snapshot = state["factors"]
+        if isinstance(self.factors_box, DeviceMailbox):
+            dev = self.worker.device if self.worker is not None else None
+            if dev is not None:
+                snapshot = jax.device_put(snapshot, dev)
+            else:
+                snapshot = jax.tree_util.tree_map(jnp.copy, snapshot)
+        return snapshot
+
+    def _worker_step_guarded(self) -> None:
+        try:
+            self.worker.step(timeout_s=self.timeout_s)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the trainer
+            self._worker_error = e
+
+    def _join_worker(self) -> None:
+        t = self._worker_thread
+        if t is not None:
+            t.join(timeout=self.timeout_s)
+            self._worker_thread = None
+        if self._worker_error is not None:
+            # A dead worker must fail the run on the TRAINER thread, not
+            # silently run the staleness deadline into its TimeoutError.
+            err, self._worker_error = self._worker_error, None
+            raise RuntimeError("curvature worker failed") from err

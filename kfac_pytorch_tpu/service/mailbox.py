@@ -1,0 +1,269 @@
+"""Versioned factor/eigenbasis mailboxes — the curvature-service transport.
+
+Two directions share one abstraction: the trainer publishes factor
+snapshots (``{layer: {"A"|"A_diag": ..., "G": ...}}``) toward the workers,
+and the workers publish refreshed eigenbases (``{layer: {"QA","dA",...}}``
+plus optional scalars) back toward the trainer. Every publish carries a
+monotonically increasing **version counter**, and a consumer only ever sees
+*complete* versions — a torn write can never hand the training step half a
+basis.
+
+Two transports, one protocol:
+
+* :class:`HostMailbox` — a directory-backed ringbuffer for the spare-host
+  worker (or any cross-process deployment). Payload-first/manifest-last
+  commit discipline, same as the elastic snapshot format (``state_io``):
+  the ``payload.npz`` is fully written before ``manifest.json`` appears via
+  an atomic rename, so ``latest()`` skipping manifest-less directories IS
+  the completeness check. Old versions are pruned to ``keep`` so an idle
+  consumer never lets the box grow without bound.
+* :class:`DeviceMailbox` — an in-process slot for the shared-pod layout
+  (trainer and worker are device subsets of one host). ``publish`` stores
+  live (possibly still-computing) jax arrays; because a jax computation's
+  results are usable the moment dispatch returns, the worker's async eigh
+  overlaps the training step and the consumer only blocks when it actually
+  reads the arrays.
+
+The payload is a two-level ``{name: {key: array}}`` dict — flattened with
+``::``-joined keys for the npz form — which covers both directions without
+the mailbox knowing which one it carries.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_PAYLOAD = "payload.npz"
+_VERSION_DIR = re.compile(r"^v-(\d{8})$")
+_KEY_SEP = "::"
+
+
+def _flatten(payload: Dict[str, Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    flat = {}
+    for name, sub in payload.items():
+        if _KEY_SEP in name:
+            raise ValueError(f"mailbox layer name may not contain '{_KEY_SEP}': {name!r}")
+        for key, value in sub.items():
+            flat[f"{name}{_KEY_SEP}{key}"] = np.asarray(value)
+    return flat
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Dict[str, np.ndarray]]:
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for fk, value in flat.items():
+        name, key = fk.split(_KEY_SEP, 1)
+        out.setdefault(name, {})[key] = value
+    return out
+
+
+class HostMailbox:
+    """Directory-backed versioned mailbox (see module docstring).
+
+    Multiple writers are not coordinated — the protocol assumes one
+    publisher per mailbox (the trainer for factors, the worker for bases);
+    multi-tenant deployments give each training job its own ``name`` under
+    a shared root (docs/SERVICE.md).
+    """
+
+    def __init__(self, root: str, name: str = "factors", keep: int = 2):
+        self.root = os.path.join(os.path.abspath(root), name)
+        self.keep = max(1, int(keep))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _version_dir(self, version: int) -> str:
+        return os.path.join(self.root, f"v-{int(version):08d}")
+
+    def publish(
+        self,
+        version: int,
+        payload: Dict[str, Dict[str, Any]],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Write version ``version``; returns its directory path.
+
+        Payload first, manifest last (atomic rename) — a reader never sees
+        a version directory as complete until every byte of the payload is
+        on disk. Refuses to move the counter backwards: versions are the
+        staleness bookkeeping, so a replayed publish must be a bug.
+        """
+        latest = self.latest_version()
+        if version <= latest:
+            raise ValueError(
+                f"mailbox version must be monotonic: publishing {version} "
+                f"after {latest}"
+            )
+        d = self._version_dir(version)
+        os.makedirs(d, exist_ok=True)
+        flat = _flatten(payload)
+        # np.savez via an explicit buffer + single write keeps a crashed
+        # publisher from leaving a short payload.npz that a LATER manifest
+        # rename could legitimize
+        buf = io.BytesIO()
+        np.savez(buf, **flat)
+        tmp = os.path.join(d, f"{_PAYLOAD}.tmp")
+        with open(tmp, "wb") as fh:
+            fh.write(buf.getvalue())
+        os.replace(tmp, os.path.join(d, _PAYLOAD))
+        manifest = {
+            "version": int(version),
+            "complete": True,
+            "published_t": time.time(),
+            "meta": dict(meta or {}),
+        }
+        mtmp = os.path.join(d, f"{_MANIFEST}.tmp")
+        with open(mtmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.replace(mtmp, os.path.join(d, _MANIFEST))
+        self._prune()
+        return d
+
+    def _complete_versions(self) -> list:
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for n in names:
+            m = _VERSION_DIR.match(n)
+            if not m:
+                continue
+            if os.path.isfile(os.path.join(self.root, n, _MANIFEST)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def versions(self) -> list:
+        """Complete versions currently present, ascending."""
+        return self._complete_versions()
+
+    def latest_version(self) -> int:
+        """Newest complete version, or -1 when the box is empty."""
+        vs = self._complete_versions()
+        return vs[-1] if vs else -1
+
+    def read(
+        self, version: int
+    ) -> Tuple[Dict[str, Dict[str, np.ndarray]], Dict[str, Any]]:
+        """``(payload, meta)`` of a complete version."""
+        d = self._version_dir(version)
+        with open(os.path.join(d, _MANIFEST)) as fh:
+            manifest = json.load(fh)
+        with np.load(os.path.join(d, _PAYLOAD)) as z:
+            flat = {k: np.array(z[k]) for k in z.files}
+        return _unflatten(flat), manifest.get("meta", {})
+
+    def latest(
+        self,
+    ) -> Optional[Tuple[int, Dict[str, Dict[str, np.ndarray]], Dict[str, Any]]]:
+        """``(version, payload, meta)`` of the newest complete version."""
+        v = self.latest_version()
+        if v < 0:
+            return None
+        payload, meta = self.read(v)
+        return v, payload, meta
+
+    def wait_for(
+        self, version: int, timeout_s: float = 60.0, poll_s: float = 0.02
+    ) -> int:
+        """Block until a complete version >= ``version`` exists; returns it.
+
+        The staleness-0 consumption path: the trainer published factors v
+        at the last boundary and must not start the next step until basis
+        v is complete. Raises ``TimeoutError`` — a dead worker must fail
+        the run loudly, not deadlock it (the Supervisor's ``worker_beat``
+        liveness is the monitoring-side view of the same failure).
+        """
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            v = self.latest_version()
+            if v >= version:
+                return v
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"curvature mailbox {self.root}: no complete version >= "
+                    f"{version} after {timeout_s}s (newest: {v}) — is the "
+                    "curvature worker alive?"
+                )
+            time.sleep(poll_s)
+
+    def _prune(self) -> None:
+        vs = self._complete_versions()
+        for v in vs[: -self.keep]:
+            shutil.rmtree(self._version_dir(v), ignore_errors=True)
+
+
+class DeviceMailbox:
+    """In-process versioned slot (shared-pod layout; see module docstring).
+
+    Keeps only the newest version — device HBM is the scarce resource, and
+    a consumer that skipped versions wants the newest anyway. Thread-safe:
+    the in-process worker may publish from a helper thread.
+    """
+
+    def __init__(self, name: str = "factors"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._version = -1
+        self._payload: Optional[Dict[str, Dict[str, Any]]] = None
+        self._meta: Dict[str, Any] = {}
+
+    def publish(
+        self,
+        version: int,
+        payload: Dict[str, Dict[str, Any]],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        # same name rule as the npz transport, so a shared-pod payload is
+        # always valid on the spare-host transport too
+        for name in payload:
+            if _KEY_SEP in name:
+                raise ValueError(
+                    f"mailbox layer name may not contain '{_KEY_SEP}': "
+                    f"{name!r}"
+                )
+        with self._lock:
+            if version <= self._version:
+                raise ValueError(
+                    f"mailbox version must be monotonic: publishing "
+                    f"{version} after {self._version}"
+                )
+            self._version = int(version)
+            self._payload = payload
+            self._meta = dict(meta or {})
+
+    def latest_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def latest(
+        self,
+    ) -> Optional[Tuple[int, Dict[str, Dict[str, Any]], Dict[str, Any]]]:
+        with self._lock:
+            if self._payload is None:
+                return None
+            return self._version, self._payload, self._meta
+
+    def wait_for(
+        self, version: int, timeout_s: float = 60.0, poll_s: float = 0.002
+    ) -> int:
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            v = self.latest_version()
+            if v >= version:
+                return v
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"curvature mailbox {self.name!r}: no version >= "
+                    f"{version} after {timeout_s}s (newest: {v}) — is the "
+                    "curvature worker alive?"
+                )
+            time.sleep(poll_s)

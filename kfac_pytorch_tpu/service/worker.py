@@ -1,0 +1,184 @@
+"""Curvature worker: runs the eigen/rsvd refresh off the training path.
+
+A :class:`CurvatureWorker` owns the carved-out device(s) from
+``split_service_mesh`` (or a spare host's local devices) and turns factor
+snapshots into eigenbases:
+
+    factors mailbox --(consume v)--> refresh() --(publish v)--> basis mailbox
+
+``refresh`` mirrors the inline world==1 refresh in ``KFAC.update`` exactly
+(replicated eigh + the embedding diag floor + the rsvd spectrum-mass scalar
++ the eigen-dtype Q downcast), which is what makes the staleness-0 service
+configuration bit-compatible with inline refresh: same factors in, same
+basis out, only the *where* and *when* moved. The service constructor
+exclusions (no streaming fold, no chunk pipeline, diag_blocks==1, no owner
+stacks) keep this single replicated path the only one the worker needs.
+
+The refresh is jitted once per factor-shape signature and dispatched onto
+the worker device; on a shared pod the trainer's next capture step and the
+worker's eigh then overlap in hardware because they occupy disjoint device
+sets and jax dispatch is async.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.parallel.sharded_eigh import replicated_eigen_update
+
+# Reserved payload key for run-level scalars riding a basis publish (the
+# mailbox otherwise carries per-layer dicts only).
+SCALARS_KEY = "__scalars__"
+
+
+class CurvatureWorker:
+    """Consumes factor snapshots, publishes refreshed eigenbases.
+
+    Parameters
+    ----------
+    kfac:
+        The (service-mode) ``KFAC`` instance — the worker reads ``eps``,
+        ``solver``/rank plumbing, and ``eigen_dtype`` from it so its math
+        tracks the trainer's configuration with no second source of truth.
+    factors, basis:
+        The two mailboxes (either transport). ``factors`` is consumed,
+        ``basis`` is published.
+    device:
+        Worker device for the refresh computation (first carved device from
+        ``split_service_mesh``). ``None`` leaves placement to jax — fine
+        for tests and the spare-host layout where the worker process owns
+        all its local devices anyway.
+    supervisor:
+        Optional elastic ``Supervisor``; when present ``serve`` emits
+        ``worker_beat`` liveness so a stalled worker is detected even
+        though it never advances the trainer's step counter.
+    """
+
+    def __init__(self, kfac, factors, basis, device=None, supervisor=None):
+        if int(getattr(kfac, "service_devices", 0) or 0) <= 0:
+            raise ValueError(
+                "CurvatureWorker requires a KFAC configured with "
+                "service_devices > 0"
+            )
+        self.kfac = kfac
+        self.factors = factors
+        self.basis = basis
+        self.device = device
+        self.supervisor = supervisor
+        self._refresh_fn = jax.jit(self._refresh_impl)
+        self.last_version = -1
+
+    # -- the math ------------------------------------------------------
+
+    def _refresh_impl(self, facs: Dict[str, Dict[str, jnp.ndarray]]):
+        """Replicated refresh — the world==1 ``update_eigen`` branch of
+        ``KFAC.update``, minus the state split (the client's install side
+        runs ``split_eigen_state`` so the published payload stays a plain
+        per-layer dict the mailbox can flatten)."""
+        kfac = self.kfac
+        names = sorted(facs.keys())
+        blocks = {name: 1 for name in names}  # diag_blocks==1 under service
+        eigen = replicated_eigen_update(
+            facs, blocks, kfac.eps, rank_fn=kfac._rank_fn()
+        )
+        for n in names:
+            if "A_diag" in facs[n]:
+                d = facs[n]["A_diag"]
+                eigen[n]["dA"] = d * (d > kfac.eps)
+        scalars = {}
+        if kfac.solver == "rsvd":
+            scalars["spectrum_mass"] = kfac._spectrum_mass(facs, eigen, names)
+        if kfac.eigen_dtype != jnp.float32:
+            eigen = {
+                n: {
+                    k: (v.astype(kfac.eigen_dtype) if k.startswith("Q") else v)
+                    for k, v in e.items()
+                }
+                for n, e in eigen.items()
+            }
+        return eigen, scalars
+
+    def refresh(
+        self, facs: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Run one refresh; returns the publishable basis payload."""
+        if self.device is not None:
+            facs = jax.device_put(facs, self.device)
+        else:
+            facs = jax.tree_util.tree_map(jnp.asarray, facs)
+        eigen, scalars = self._refresh_fn(facs)
+        payload = dict(eigen)
+        if scalars:
+            payload[SCALARS_KEY] = scalars
+        return payload
+
+    # -- the loop ------------------------------------------------------
+
+    def step(self, timeout_s: float = 0.0) -> Optional[int]:
+        """Process at most one new factor snapshot; returns its version.
+
+        With ``timeout_s`` 0 this is a poll (returns ``None`` when no new
+        snapshot is pending); positive blocks for the next one.
+        """
+        tel = get_telemetry()
+        if timeout_s > 0:
+            try:
+                self.factors.wait_for(self.last_version + 1, timeout_s=timeout_s)
+            except TimeoutError:
+                return None
+        got = self.factors.latest()
+        if got is None:
+            return None
+        version, facs, meta = got
+        if version <= self.last_version:
+            return None
+        t0 = time.monotonic()
+        payload = self.refresh(facs)
+        # Block for completion before publishing: "complete version" must
+        # mean the numbers exist, not that a computation was dispatched.
+        payload = jax.device_get(payload)
+        refresh_ms = (time.monotonic() - t0) * 1000.0
+        self.basis.publish(version, payload, meta={**meta, "refresh_ms": refresh_ms})
+        self.last_version = version
+        tel.set_gauge("kfac/basis_version", version)
+        tel.observe("kfac/service_refresh_ms", refresh_ms)
+        if self.supervisor is not None:
+            self.supervisor.worker_beat(version=version)
+        return version
+
+    def serve(
+        self,
+        stop_version: Optional[int] = None,
+        idle_timeout_s: float = 60.0,
+        poll_s: float = 0.01,
+    ) -> int:
+        """Refresh loop for a dedicated worker process/thread.
+
+        Runs until a snapshot with version >= ``stop_version`` has been
+        served (or forever when ``None``); raises ``TimeoutError`` after
+        ``idle_timeout_s`` without any new snapshot — a silent trainer is
+        an error, mirroring the trainer-side ``wait_for`` discipline.
+        Returns the last served version.
+        """
+        last_new = time.monotonic()
+        while True:
+            v = self.step(timeout_s=0.0)
+            if v is not None:
+                last_new = time.monotonic()
+                if stop_version is not None and v >= stop_version:
+                    return v
+            else:
+                if self.supervisor is not None:
+                    self.supervisor.worker_beat(version=self.last_version)
+                if time.monotonic() - last_new > idle_timeout_s:
+                    raise TimeoutError(
+                        "curvature worker idle: no factor snapshot in "
+                        f"{idle_timeout_s}s (last served version "
+                        f"{self.last_version})"
+                    )
+                time.sleep(poll_s)

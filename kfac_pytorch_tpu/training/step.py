@@ -624,24 +624,38 @@ def kfac_flags_for_step(
     re-orthonormalize unconditionally. Drift-gated re-orth skipping needs
     the stateful ``scheduler.EigenRefreshCadence`` with a wired
     ``kfac.stream_drift_signal``.
+
+    Under the curvature service (``service_devices > 0``) ``update_eigen``
+    never fires — the refresh runs on the carved workers and
+    ``service.ServiceClient`` installs published bases between steps; only
+    capture flags (and boundary-forced deferred flushes, so the published
+    snapshot is globally merged) remain.
     """
     if kfac is None:
         return {"update_factors": False, "update_eigen": False}
     hp = kfac.hparams
+    service = int(getattr(kfac, "service_devices", 0) or 0) > 0
+    boundary = step % hp.kfac_update_freq == 0
     flags = {
         "update_factors": step % hp.fac_update_freq == 0,
-        "update_eigen": step % hp.kfac_update_freq == 0,
+        "update_eigen": boundary and not service,
         "diag_warmup_done": epoch is None or epoch >= kfac.diag_warmup,
     }
     comm = getattr(kfac, "factor_comm", None)
     if comm is not None and comm.defer:
         # Deferred factor communication: merge the per-replica running
         # averages every comm_freq-th CAPTURE step, and always on an eigen
-        # refresh (which must never read unmerged local factors). Key only
-        # present in deferred mode, so other configs' flag dicts (and
-        # compiled-variant sets) are untouched.
-        flags["flush_factors"] = flags["update_eigen"] or (
-            flags["update_factors"]
-            and (step // hp.fac_update_freq) % comm.comm_freq == 0
+        # refresh (which must never read unmerged local factors) or — in
+        # service mode — at every boundary whose post-step factor snapshot
+        # gets published to the workers. Key only present in deferred
+        # mode, so other configs' flag dicts (and compiled-variant sets)
+        # are untouched.
+        flags["flush_factors"] = (
+            flags["update_eigen"]
+            or (service and boundary)
+            or (
+                flags["update_factors"]
+                and (step // hp.fac_update_freq) % comm.comm_freq == 0
+            )
         )
     return flags

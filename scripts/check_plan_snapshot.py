@@ -153,6 +153,25 @@ FIXTURES = {
         data_world=8,
         mesh_axes=("data", "tensor"),
     ),
+    # fixture 5: ResNet-50 again, but the operator offers a 2-device
+    # curvature carve (--service-devices 2) under an aggressive refresh
+    # cadence (K=10). The dense refresh per interval (5.0e11 MACs) clears
+    # the engagement bar (3 · 2/32 · 10 · precond ≈ 1.5e11), so the cost
+    # model moves the refresh off-step: service_devices=2 +
+    # staleness_budget=1, solver back to dense eigh, chunks 1, REPLICATED
+    # factors (service_vs_owner_sharding), wire/overlap levers intact. At
+    # the default K=100 the same offer is declined (refresh amortizes
+    # below the carved devices' capture loss) — fixture 2 pins that side.
+    "resnet50_x32_service": dict(
+        shapes=_RESNET50,
+        diag_a=(),
+        has_conv=True,
+        world=32,
+        mesh_axes=("data",),
+        service_devices=2,
+        fac_update_freq=1,
+        kfac_update_freq=10,
+    ),
 }
 
 
@@ -172,6 +191,9 @@ def resolve_fixture(name: str) -> dict:
         on_tpu=True,
         has_diag_a_layers=facts.has_diag_a,
         has_conv_layers=facts.has_conv,
+        fac_update_freq=fx.get("fac_update_freq", 10),
+        kfac_update_freq=fx.get("kfac_update_freq", 100),
+        service_devices=fx.get("service_devices", 0),
     )
     plan, report, dropped = resolve_profile("production", facts, env)
     return {

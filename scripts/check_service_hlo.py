@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Pin the curvature service's off-path guarantee in compiled HLO.
+
+``KFAC(service_devices=N)`` moves the eigendecomposition refresh onto
+dedicated worker devices (kfac_pytorch_tpu/service/): the trainer's compiled
+step captures statistics and preconditions, the worker's compiled program
+refreshes bases. This check carves a 1-worker service split off a 3-device
+CPU backend and pins the division of labor at the HLO level:
+
+* the INLINE refresh step (no service, same training mesh) contains at
+  least one eigh custom-call — detector sanity, exactly as in
+  ``check_solver_hlo.py``: if the backend renames its eigh target this
+  fails loudly instead of letting the zero-assertions pass vacuously;
+* the SERVICE training step (the only flag combination service mode
+  compiles: capture + precondition, ``update_eigen`` refused) contains
+  ZERO eigh custom-calls of any size, and exactly the same collective
+  instruction count as the inline capture-only step — carving the service
+  must not add refresh collectives to the per-step program;
+* the WORKER refresh program contains at least one eigh and ZERO
+  collectives — the worker consumes a complete replicated snapshot and
+  never joins gradient or factor communication;
+* structurally, service-mode ``KFAC.update`` *raises* on
+  ``update_eigen=True`` — an inline refresh cannot be compiled at all.
+
+Exit 0 with an "OK" line, 1 with a report. Run from the repo root
+(tier-1 wraps it in a test, tests/test_scripts.py).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kfac_pytorch_tpu import platform_override  # noqa: E402
+
+if not platform_override.force_cpu_devices(3):
+    print("check_service_hlo: SKIP — could not force 3 CPU devices "
+          "(backend already initialized)", file=sys.stderr)
+    sys.exit(1)
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from kfac_pytorch_tpu import KFAC  # noqa: E402
+from kfac_pytorch_tpu.models.layers import KFACDense  # noqa: E402
+from kfac_pytorch_tpu.parallel.mesh import split_service_mesh  # noqa: E402
+from kfac_pytorch_tpu.service.worker import CurvatureWorker  # noqa: E402
+from kfac_pytorch_tpu.training.step import (  # noqa: E402
+    TrainState,
+    make_sgd,
+    make_train_step,
+)
+
+# same detectors as check_solver_hlo.py: eigh custom-call targets across
+# the backends this repo meets, and collective op mnemonics at instruction
+# sites (sync and async-start spellings; -done carries no replica work)
+_EIGH_TARGET = re.compile(r"custom_call_target=\"[^\"]*(?:syevd|[Ee]igh|qdwh)")
+_COLLECTIVE = re.compile(
+    r"\b(?:all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\("
+)
+
+
+def _eigh_calls(hlo: str) -> list:
+    return [
+        line.strip()[:140]
+        for line in hlo.splitlines()
+        if "custom-call" in line and _EIGH_TARGET.search(line)
+    ]
+
+
+def _collective_calls(hlo: str) -> list:
+    return [
+        line.strip()[:140] for line in hlo.splitlines()
+        if _COLLECTIVE.search(line)
+    ]
+
+
+class _Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(KFACDense(24, name="fc1")(x))
+        x = nn.relu(KFACDense(16, name="fc2")(x))
+        return KFACDense(10, name="fc3")(x)
+
+
+def _step_hlo(mesh, kfac, model, x, y, **flags) -> str:
+    tx = make_sgd(momentum=0.9)
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    batch = tuple(
+        jax.device_put(b, NamedSharding(mesh, P("data"))) for b in (x, y)
+    )
+    step_fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True},
+        mesh=mesh, grad_comm_dtype=jnp.float32,
+    )
+    lowered = step_fn.lower(
+        state, batch, jnp.float32(0.1), jnp.float32(0.01), **flags
+    )
+    return lowered.compile().as_text()
+
+
+def main() -> int:
+    train_mesh, workers = split_service_mesh(1)
+    if len(workers) != 1 or train_mesh.devices.size != 2:
+        print(
+            f"check_service_hlo: FAIL — split_service_mesh(1) on 3 devices "
+            f"gave a {train_mesh.devices.size}-device training mesh and "
+            f"{len(workers)} worker(s)", file=sys.stderr,
+        )
+        return 1
+
+    model = _Net()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(8, 24), jnp.float32)
+    y = jnp.asarray(r.randint(0, 10, (8,)), jnp.int32)
+    mk = dict(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+
+    inline = KFAC(mesh=train_mesh, **mk)
+    service = KFAC(mesh=train_mesh, service_devices=1, **mk)
+
+    # 1. detector sanity: the inline refresh step must show an eigh
+    inline_refresh = _step_hlo(
+        train_mesh, inline, model, x, y,
+        update_factors=True, update_eigen=True,
+    )
+    if not _eigh_calls(inline_refresh):
+        print(
+            "check_service_hlo: FAIL — the INLINE refresh step shows no eigh "
+            "custom-call; the detector no longer recognizes this backend's "
+            "eigh target and the service zero-assertions below would pass "
+            "vacuously", file=sys.stderr,
+        )
+        return 1
+
+    # 2. the service training step: zero eighs, no extra collectives vs the
+    # inline capture-only step on the same mesh
+    inline_capture = _step_hlo(
+        train_mesh, inline, model, x, y,
+        update_factors=True, update_eigen=False,
+    )
+    service_step = _step_hlo(
+        train_mesh, service, model, x, y,
+        update_factors=True, update_eigen=False,
+    )
+    svc_eighs = _eigh_calls(service_step)
+    if svc_eighs:
+        print(
+            f"check_service_hlo: FAIL — the service training step contains "
+            f"{len(svc_eighs)} eigh custom-call(s); refresh leaked back onto "
+            "the critical path:", file=sys.stderr,
+        )
+        for line in svc_eighs[:5]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+    base_colls = len(_collective_calls(inline_capture))
+    svc_colls = len(_collective_calls(service_step))
+    if svc_colls != base_colls:
+        print(
+            f"check_service_hlo: FAIL — the service training step has "
+            f"{svc_colls} collective instruction(s) vs {base_colls} in the "
+            "inline capture-only step; the carve must not change per-step "
+            "communication", file=sys.stderr,
+        )
+        return 1
+
+    # 3. the worker refresh program: >= 1 eigh, zero collectives
+    worker = CurvatureWorker(
+        service,
+        factors=None, basis=None,  # compiling the math only
+        device=workers[0],
+    )
+    state = service.init(
+        model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    )
+    facs = jax.tree_util.tree_map(jnp.asarray, state["factors"])
+    worker_hlo = jax.jit(worker._refresh_impl).lower(facs).compile().as_text()
+    w_eighs = _eigh_calls(worker_hlo)
+    w_colls = _collective_calls(worker_hlo)
+    if not w_eighs:
+        print(
+            "check_service_hlo: FAIL — the worker refresh program shows no "
+            "eigh custom-call; the refresh moved but its math is gone",
+            file=sys.stderr,
+        )
+        return 1
+    if w_colls:
+        print(
+            f"check_service_hlo: FAIL — the worker refresh program contains "
+            f"{len(w_colls)} collective instruction(s); the worker must not "
+            "join gradient or factor communication:", file=sys.stderr,
+        )
+        for line in w_colls[:5]:
+            print(f"  {line}", file=sys.stderr)
+        return 1
+
+    # 4. structural pin: service-mode update refuses an inline refresh
+    try:
+        _step_hlo(
+            train_mesh, service, model, x, y,
+            update_factors=True, update_eigen=True,
+        )
+    except ValueError:
+        pass
+    else:
+        print(
+            "check_service_hlo: FAIL — service-mode KFAC.update accepted "
+            "update_eigen=True; the inline refresh must be refused under "
+            "service_devices > 0", file=sys.stderr,
+        )
+        return 1
+
+    print(
+        "check_service_hlo: OK — service training step has zero eigh "
+        f"custom-calls and {svc_colls} collective(s) (== inline capture "
+        f"baseline); worker refresh has {len(w_eighs)} eigh(s) and zero "
+        "collectives; inline-refresh compilation is refused under service "
+        "mode"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

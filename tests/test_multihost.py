@@ -224,6 +224,106 @@ out["stream_resume_bitwise"] = bool(all(
     )
 ))
 out["stream_resume_param_sum"] = _psum(r5.params)
+
+# PR-14: decoupled curvature service on a REAL 2-process world, spare-host
+# layout — the ONLY coupling between the roles is a shared HostMailbox
+# directory. Process 0 publishes factor snapshots at refresh boundaries,
+# process 1 runs the CurvatureWorker refresh, and BOTH trainer processes
+# install the same published basis bytes so the train step stays SPMD.
+import hashlib
+from kfac_pytorch_tpu.service import CurvatureWorker, HostMailbox, ServiceClient
+
+def _sha(payload):
+    h = hashlib.sha256()
+    for name in sorted(payload):
+        for key in sorted(payload[name]):
+            h.update(name.encode()); h.update(key.encode())
+            h.update(np.ascontiguousarray(payload[name][key]).tobytes())
+    return h.hexdigest()
+
+svcdir = os.path.join(os.environ["KFAC_SNAPDIR"], "service-mailboxes")
+fbox = HostMailbox(svcdir, "job0-factors")
+bbox = HostMailbox(svcdir, "job0-basis")
+svc_kw = dict(damping=0.003, fac_update_freq=1, kfac_update_freq=2,
+              service_devices=1)
+kfac6 = KFAC(mesh=mesh, **svc_kw)
+worker_kfac = KFAC(**svc_kw)  # the worker role needs no training mesh
+params6 = _fresh_params()
+st6 = TrainState(step=jnp.zeros((), jnp.int32), params=params6, batch_stats={},
+                 opt_state=tx.init(params6), kfac_state=kfac6.init(params6))
+st6 = jax.device_put(st6, NamedSharding(mesh, P()))
+fn6 = make_train_step(model, tx, kfac6, train_kwargs={"train": True})
+cad6 = EigenRefreshCadence(kfac6)
+client6 = ServiceClient(kfac6, cad6)
+svc_snapdir = os.path.join(os.environ["KFAC_SNAPDIR"], "service-snap")
+versions6, shas6 = [], []
+
+def _service_boundary(i, st, client, factors_box, basis_box, version):
+    # publish (trainer role, proc 0) -> refresh (worker role, proc 1) ->
+    # install (BOTH trainer processes, same bytes). Staleness 0: block on
+    # the fresh basis before the next step.
+    if pid == 0:
+        factors_box.publish(version, jax.device_get(st.kfac_state["factors"]),
+                            meta={"step": i})
+    if pid == 1:
+        CurvatureWorker(worker_kfac, factors_box, basis_box).serve(
+            stop_version=version, idle_timeout_s=180)
+    v = basis_box.wait_for(version, timeout_s=180)
+    payload, _meta = basis_box.read(v)
+    return st.replace(kfac_state=client.install(st.kfac_state, payload, v,
+                                                i + 1)), v, _sha(payload)
+
+for i in range(4):
+    fl6 = cad6.flags_for_step(i)
+    assert not fl6["update_eigen"], "service cadence fired an inline refresh"
+    st6, _ = fn6(st6, batch, jnp.float32(0.1), jnp.float32(0.003), **fl6)
+    if i % 2 == 0:
+        st6, v6, sha6 = _service_boundary(i, st6, client6, fbox, bbox,
+                                          1 + i // 2)
+        versions6.append(v6); shas6.append(sha6)
+    if i == 1:
+        # mid-run split-role snapshot: the installed service basis and the
+        # cadence's basis bookkeeping both ride the elastic manifest
+        sup6 = Supervisor(svc_snapdir, kfac=kfac6, cadence=cad6)
+        sup6.snapshot(2, st6, sync=True)
+        launch.barrier("svc-snap")
+out["svc_versions"] = versions6
+out["svc_basis_sha"] = shas6
+out["svc_param_sum"] = _psum(st6.params)
+
+# resume the split-role run from the mid-run snapshot: both roles come back
+# (fresh mailbox tenant — a post-preemption worker fleet starts a fresh
+# version space; durable state rides the snapshot, not the mailboxes) and
+# the continued run must equal the uninterrupted one bitwise.
+fbox_r = HostMailbox(svcdir, "resume-factors")
+bbox_r = HostMailbox(svcdir, "resume-basis")
+kfac7 = KFAC(mesh=mesh, **svc_kw)
+params7 = _fresh_params()
+st7 = TrainState(step=jnp.zeros((), jnp.int32), params=params7, batch_stats={},
+                 opt_state=tx.init(params7), kfac_state=kfac7.init(params7))
+cad7 = EigenRefreshCadence(kfac7)
+sup7 = Supervisor(svc_snapdir, kfac=kfac7, cadence=cad7)
+hit7 = sup7.scan_resume(jax.device_get(st7), params=st7.params)
+assert hit7 is not None, "no service snapshot found on resume"
+r7, manifest7, rstep7 = hit7
+assert rstep7 == 2, rstep7
+out["svc_resume_basis_version"] = cad7.state_dict()["basis_version"]
+r7 = jax.device_put(r7, NamedSharding(mesh, P()))
+client7 = ServiceClient(kfac7, cad7)
+fn7 = make_train_step(model, tx, kfac7, train_kwargs={"train": True})
+for i in range(2, 4):
+    r7, _ = fn7(r7, batch, jnp.float32(0.1), jnp.float32(0.003),
+                **cad7.flags_for_step(i))
+    if i % 2 == 0:
+        r7, _v, sha7 = _service_boundary(i, r7, client7, fbox_r, bbox_r, 1)
+        out["svc_resume_basis_sha"] = sha7
+out["svc_resume_bitwise"] = bool(all(
+    np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(st6.params)),
+        jax.tree_util.tree_leaves(jax.device_get(r7.params)),
+    )
+))
 print("RESULT " + json.dumps(out), flush=True)
 """
 
@@ -436,6 +536,32 @@ def test_owner_streaming_fold_spmd(world):
     # the fold really ran: a third program beyond the two earlier models
     # trained to different params
     assert r0["owner_stream_param_sum"] != r0["param_sum"]
+
+
+def test_service_split_roles_publish_consume(world):
+    """Spare-host curvature service over a shared HostMailbox directory:
+    process 0 publishes factor snapshots, process 1 refreshes, both trainer
+    processes install. Versions are monotonic, and the installed basis
+    bytes agree BITWISE across processes (sha256 of the published npz
+    payload) — the two roles never exchange anything else."""
+    r0, r1 = world
+    assert r0["svc_versions"] == r1["svc_versions"] == [1, 2]
+    assert r0["svc_basis_sha"] == r1["svc_basis_sha"]
+    assert len(set(r0["svc_basis_sha"])) == 2  # refreshes actually differ
+    assert r0["svc_param_sum"] == r1["svc_param_sum"]
+
+
+def test_service_split_role_snapshot_resume(world):
+    """A mid-run snapshot of the split-role service run resumes bitwise:
+    the manifest's cadence dict carries the installed basis version, the
+    restored trainer replays the remaining steps (fresh mailbox tenant for
+    the post-preemption worker fleet), and the re-published boundary basis
+    has the SAME bytes as the uninterrupted run's second refresh."""
+    r0, r1 = world
+    assert r0["svc_resume_bitwise"] and r1["svc_resume_bitwise"]
+    assert r0["svc_resume_basis_version"] == r1["svc_resume_basis_version"] == 1
+    assert r0["svc_resume_basis_sha"] == r0["svc_basis_sha"][1]
+    assert r1["svc_resume_basis_sha"] == r1["svc_basis_sha"][1]
 
 
 def test_stream_snapshot_resume_across_processes(world):

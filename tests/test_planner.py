@@ -187,6 +187,13 @@ _LEVERS = {
     "streaming+staleness": Plan(
         solver="streaming", staleness_budget=1, factor_comm_freq=2
     ),
+    # curvature service: valid alone (and env rules trip it under
+    # inverse / diag_blocks); each plan-internal exclusion gets a pair
+    "service": Plan(service_devices=1),
+    "service+staleness": Plan(service_devices=1, staleness_budget=1),
+    "service+streaming": Plan(service_devices=1, solver="streaming"),
+    "service+chunks": Plan(service_devices=1, eigh_chunks=2),
+    "service+owner": Plan(service_devices=1, factor_sharding="owner"),
 }
 
 # environment features, each mapping to (PlanEnv kwargs, KFAC kwargs)
@@ -504,6 +511,60 @@ def test_plan_dict_round_trip_and_unknown_fields():
     assert Plan.from_dict(plan.to_dict()) == plan
     with pytest.raises(ValueError, match="unknown Plan fields"):
         Plan.from_dict({"warp_speed": 9})
+    svc = Plan(service_devices=2, staleness_budget=1)
+    assert Plan.from_dict(svc.to_dict()) == svc
+    assert Plan.from_state(svc.to_state()) == svc
+    # pre-service checkpoints lack the field: refresh stays in-step
+    legacy = dict(svc.to_state())
+    legacy.pop("service_devices")
+    assert Plan.from_state(legacy).service_devices == 0
+
+
+# ---------------------------------------------------------------------------
+# curvature-service engagement (cost model)
+# ---------------------------------------------------------------------------
+
+
+def test_service_engages_only_past_carve_bar():
+    """The cost model may spend the operator's carve offer only when the
+    dense refresh per interval beats the carved devices' lost capture
+    compute by SERVICE_MIN_REFRESH_RATIO — and never invents a carve the
+    env didn't offer."""
+    from kfac_pytorch_tpu.planner.cost_model import (
+        refresh_cost, service_carve_cost,
+    )
+
+    # no offer → no service, whatever the shapes
+    plan, report, _ = resolve_profile(
+        "production", _BIG_FACTS, _env(world=32, on_tpu=True)
+    )
+    assert plan.service_devices == 0 and report.service_carve_cost == 0
+
+    # offered + aggressive refresh (K=10): dense refresh clears the bar
+    hot = _env(
+        world=32, on_tpu=True, service_devices=2,
+        fac_update_freq=1, kfac_update_freq=10,
+    )
+    plan, report, dropped = resolve_profile("production", _BIG_FACTS, hot)
+    assert refresh_cost(_BIG_FACTS, Plan()) > service_carve_cost(
+        _BIG_FACTS, hot
+    )
+    assert plan.service_devices == 2
+    assert plan.staleness_budget == 1  # install-slip budget rides along
+    # service supersedes the in-step refresh levers...
+    assert plan.solver == "eigh"
+    assert plan.eigh_chunks == 1
+    assert plan.factor_sharding == "replicated"
+    # ...without tripping any validity rule on the way out
+    assert not dropped
+    assert report.service_devices == 2 and report.service_carve_cost > 0
+
+    # offered but lazy refresh (default K=100): amortized in-step refresh
+    # is cheaper than the carve — the offer is declined, streaming engages
+    cold = _env(world=32, on_tpu=True, service_devices=2)
+    plan, report, _ = resolve_profile("production", _BIG_FACTS, cold)
+    assert plan.service_devices == 0
+    assert report.service_devices == 0 and report.service_carve_cost > 0
 
 
 # ---------------------------------------------------------------------------

@@ -114,6 +114,20 @@ def test_solver_hlo_check():
     assert "OK" in res.stdout
 
 
+def test_service_hlo_check():
+    """Under ``service_devices > 0`` the compiled training step must contain
+    zero eigendecomposition custom-calls and no refresh collectives, and the
+    worker refresh program must contain no gradient/factor communication —
+    the curvature refresh lives off the critical path or not at all
+    (scripts/check_service_hlo.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_service_hlo.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_plan_snapshot_check():
     """The production profile's resolved plan for the three canonical
     (model, mesh) fixtures must match the checked-in goldens — silent

@@ -1,0 +1,427 @@
+"""Decoupled curvature service (kfac_pytorch_tpu/service/, docs/SERVICE.md).
+
+Covers the mailbox transport contract (monotonic versions, completeness,
+pruning), the mesh carve, the constructor/update validity fence, the
+worker-vs-inline refresh math, the cadence's service branch, worker
+liveness, and the acceptance criterion: a staleness-0 service run is
+numerically equivalent to inline refresh, step by step.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC, EigenRefreshCadence
+from kfac_pytorch_tpu.parallel.mesh import split_service_mesh
+from kfac_pytorch_tpu.service import (
+    CurvatureService,
+    CurvatureWorker,
+    DeviceMailbox,
+    HostMailbox,
+    ServiceClient,
+)
+
+from test_preconditioner import _dense_params, _stats_for
+
+
+def _payload(v=1.0):
+    return {"l0": {"QA": np.full((3, 3), v, np.float32),
+                   "dA": np.arange(3, dtype=np.float32)}}
+
+
+# -- mailbox transports -------------------------------------------------
+
+
+def _boxes(tmp_path):
+    return [HostMailbox(str(tmp_path), "factors"), DeviceMailbox("factors")]
+
+
+def test_mailbox_monotonic_version_refused(tmp_path):
+    for box in _boxes(tmp_path):
+        box.publish(3, _payload())
+        with pytest.raises(ValueError, match="monotonic"):
+            box.publish(3, _payload())
+        with pytest.raises(ValueError, match="monotonic"):
+            box.publish(2, _payload())
+        assert box.latest_version() == 3
+
+
+def test_mailbox_wait_for_timeout(tmp_path):
+    for box in _boxes(tmp_path):
+        box.publish(1, _payload())
+        assert box.wait_for(1, timeout_s=1.0) == 1
+        with pytest.raises(TimeoutError, match="worker alive"):
+            box.wait_for(2, timeout_s=0.05)
+
+
+def test_mailbox_roundtrip_and_meta(tmp_path):
+    box = HostMailbox(str(tmp_path), "basis")
+    sent = _payload(2.5)
+    box.publish(1, sent, meta={"step": 40})
+    got, meta = box.read(1)
+    assert meta == {"step": 40}
+    np.testing.assert_array_equal(got["l0"]["QA"], sent["l0"]["QA"])
+    np.testing.assert_array_equal(got["l0"]["dA"], sent["l0"]["dA"])
+
+
+def test_host_mailbox_prunes_to_keep(tmp_path):
+    box = HostMailbox(str(tmp_path), "factors", keep=2)
+    for v in (1, 2, 3, 4):
+        box.publish(v, _payload(float(v)))
+    assert box.versions() == [3, 4]
+    got, _ = box.read(4)
+    assert got["l0"]["QA"][0, 0] == 4.0
+
+
+def test_host_mailbox_ignores_manifestless_version(tmp_path):
+    """Payload-first/manifest-last: a torn publish (no manifest yet) must be
+    invisible to latest()/versions()."""
+    box = HostMailbox(str(tmp_path), "factors")
+    box.publish(1, _payload())
+    torn = os.path.join(box.root, "v-00000002")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "payload.npz"), "wb") as fh:
+        fh.write(b"garbage")
+    assert box.latest_version() == 1
+
+
+def test_mailbox_refuses_separator_in_layer_name(tmp_path):
+    for box in _boxes(tmp_path):
+        with pytest.raises(ValueError, match="::"):
+            box.publish(1, {"a::b": {"QA": np.zeros((2, 2), np.float32)}})
+
+
+# -- mesh carve ---------------------------------------------------------
+
+
+def test_split_service_mesh_carves_trailing_devices():
+    devices = jax.devices()
+    mesh, workers = split_service_mesh(2)
+    assert mesh.devices.size == len(devices) - 2
+    assert list(mesh.devices.ravel()) == devices[:-2]
+    assert workers == tuple(devices[-2:])
+    # 0 degenerates to the plain data mesh so call sites thread the lever
+    mesh0, workers0 = split_service_mesh(0)
+    assert mesh0.devices.size == len(devices) and workers0 == ()
+    with pytest.raises(ValueError, match="no training devices"):
+        split_service_mesh(len(devices))
+    with pytest.raises(ValueError, match=">= 0"):
+        split_service_mesh(-1)
+
+
+# -- validity fence -----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kwargs, rule",
+    [
+        (dict(precond_method="inverse"), "service_vs_inverse"),
+        (dict(solver="streaming"), "service_vs_streaming"),
+        (dict(eigh_chunks=2), "service_vs_chunks"),
+        (dict(diag_blocks=2), "service_vs_diag_blocks"),
+    ],
+)
+def test_service_constructor_exclusions(kwargs, rule):
+    with pytest.raises(ValueError, match=rule):
+        KFAC(damping=0.01, service_devices=1, **kwargs)
+
+
+def test_service_vs_owner_sharding_on_multi_device_mesh():
+    mesh, _workers = split_service_mesh(1)
+    assert mesh.devices.size > 1
+    with pytest.raises(ValueError, match="service_vs_owner_sharding"):
+        KFAC(damping=0.01, service_devices=1, mesh=mesh,
+             factor_sharding="owner")
+
+
+def test_service_composes_with_staleness_budget():
+    kfac = KFAC(damping=0.01, service_devices=1, staleness_budget=2)
+    assert kfac.service_devices == 1 and kfac.staleness_budget == 2
+
+
+def test_service_update_refuses_inline_refresh():
+    params = _dense_params(np.random.RandomState(0), [4, 3])
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                service_devices=1)
+    state = kfac.init(params)
+    a, g, grads = _stats_for(params, np.random.RandomState(1))
+    with pytest.raises(ValueError, match="ServiceClient.install"):
+        kfac.update(grads, state, a_contribs=a, g_factor_stats=g,
+                    lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+                    update_factors=True, update_eigen=True)
+
+
+# -- worker refresh math ------------------------------------------------
+
+
+def _captured_state(kfac, params, seed=1):
+    """One capture step so the factor EMAs hold real statistics."""
+    a, g, grads = _stats_for(params, np.random.RandomState(seed))
+    _, state = kfac.update(
+        grads, kfac.init(params), a_contribs=a, g_factor_stats=g,
+        lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+        update_factors=True, update_eigen=False,
+    )
+    return state, (a, g, grads)
+
+
+def test_worker_refresh_matches_inline_eigen():
+    """The worker's standalone refresh program on a factor snapshot must
+    produce the same basis the inline ``update_eigen=True`` branch computes
+    from identical factors."""
+    params = _dense_params(np.random.RandomState(0), [6, 5, 4])
+    kfac_s = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                  service_devices=1)
+    kfac_i = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1)
+    state_s, _ = _captured_state(kfac_s, params)
+    state_i, (a, g, grads) = _captured_state(kfac_i, params)
+
+    _, state_i = kfac_i.update(
+        grads, state_i, a_contribs=a, g_factor_stats=g,
+        lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+        update_factors=False, update_eigen=True,
+    )
+
+    factors_box, basis_box = DeviceMailbox("f"), DeviceMailbox("b")
+    worker = CurvatureWorker(kfac_s, factors_box, basis_box)
+    factors_box.publish(1, state_s["factors"])
+    assert worker.step() == 1
+    version, payload, _meta = basis_box.latest()
+    client = ServiceClient(kfac_s)
+    state_s = client.install(state_s, payload, version, step=1)
+    assert client.installed_version == 1
+
+    for key in ("eigen", "eigen_stacked"):
+        ls = sorted(
+            (jax.tree_util.keystr(p), v)
+            for p, v in jax.tree_util.tree_leaves_with_path(state_s[key])
+        )
+        li = sorted(
+            (jax.tree_util.keystr(p), v)
+            for p, v in jax.tree_util.tree_leaves_with_path(state_i[key])
+        )
+        assert [k for k, _ in ls] == [k for k, _ in li]
+        for (k, vs), (_, vi) in zip(ls, li):
+            np.testing.assert_allclose(
+                np.asarray(vs), np.asarray(vi), rtol=1e-6, atol=1e-7,
+                err_msg=f"{key} leaf {k}")
+
+
+def test_worker_skips_stale_and_serves_to_stop_version():
+    params = _dense_params(np.random.RandomState(0), [4, 3])
+    kfac = KFAC(damping=0.01, service_devices=1)
+    state, _ = _captured_state(kfac, params)
+    factors_box, basis_box = DeviceMailbox("f"), DeviceMailbox("b")
+    worker = CurvatureWorker(kfac, factors_box, basis_box)
+    assert worker.step() is None  # nothing published yet
+    factors_box.publish(1, state["factors"])
+    assert worker.serve(stop_version=1, idle_timeout_s=5.0) == 1
+    assert worker.step() is None  # version 1 already served
+    assert basis_box.latest_version() == 1
+
+
+def test_publish_survives_donated_trainer_state():
+    """The trainer's jitted step donates its state, deleting the live
+    factor arrays a pointer-handoff publish would still reference — the
+    service must snapshot into non-donatable buffers at publish time, and
+    an async worker that DOES die must fail the trainer loudly instead of
+    running the staleness deadline into a bare TimeoutError."""
+    params = _dense_params(np.random.RandomState(0), [6, 5, 4])
+    train_mesh, workers = split_service_mesh(1, devices=jax.devices()[:2])
+    kfac = KFAC(damping=0.003, fac_update_freq=1, kfac_update_freq=2,
+                mesh=train_mesh, service_devices=1)
+    state, _ = _captured_state(kfac, params)
+    svc = CurvatureService(kfac, worker_devices=workers,
+                           async_worker=True, staleness_budget=0)
+    # publish, then donate the state BEFORE the worker thread reads it —
+    # the exact interleaving of the trainer's next dispatched step
+    svc.published_version += 1
+    svc.published_step = 0
+    svc.factors_box.publish(
+        svc.published_version, svc._snapshot_factors(state)
+    )
+    donating = jax.jit(
+        lambda s: jax.tree_util.tree_map(lambda x: x * 1.0, s),
+        donate_argnums=0,
+    )
+    state = donating(state)
+    assert svc.worker.step() == 1  # refresh reads the re-homed snapshot
+    assert svc.basis_box.latest_version() == 1
+
+    # loud failure: a worker that died async surfaces on the trainer thread
+    svc._worker_error = RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="curvature worker failed"):
+        svc._join_worker()
+    assert svc._worker_error is None  # raised once, not sticky
+
+
+# -- end-to-end staleness-0 parity (the acceptance criterion) -----------
+
+
+def test_service_staleness0_matches_inline_refresh():
+    """Publish after boundary step s, refresh out-of-band, install before
+    s+1: with staleness budget 0 every preconditioned update must match the
+    inline schedule whose eigen step at s+1 does not capture (so its eigen
+    input is exactly the snapshot the worker saw)."""
+    FAC, KF, STEPS = 2, 4, 8
+    params = _dense_params(np.random.RandomState(0), [6, 5, 4])
+    # 1-trainer-device + 1-worker-device carve, as the parity protocol
+    # specifies — the multi-device capture path is covered elsewhere
+    train_mesh, workers = split_service_mesh(1, devices=jax.devices()[:2])
+    kfac_s = KFAC(damping=0.003, fac_update_freq=FAC, kfac_update_freq=KF,
+                  mesh=train_mesh, service_devices=1)
+    kfac_i = KFAC(damping=0.003, fac_update_freq=FAC, kfac_update_freq=KF)
+    state_s, state_i = kfac_s.init(params), kfac_i.init(params)
+
+    cad = EigenRefreshCadence(kfac_s)
+    svc = CurvatureService(kfac_s, cad, worker_devices=workers,
+                           async_worker=False, staleness_budget=0)
+
+    def apply(kfac, grads, state, a, g, **flags):
+        return kfac.update(grads, state, a_contribs=a, g_factor_stats=g,
+                           lr=jnp.float32(0.1), damping=jnp.float32(0.003),
+                           **flags)
+
+    versions = []
+    for step in range(STEPS):
+        a, g, grads = _stats_for(params, np.random.RandomState(100 + step))
+
+        state_s = svc.before_step(step, state_s)
+        fl = cad.flags_for_step(step)
+        assert not fl["update_eigen"]
+        out_s, state_s = apply(kfac_s, grads, state_s, a, g,
+                               update_factors=fl["update_factors"],
+                               update_eigen=False,
+                               flush_factors=fl.get("flush_factors", False))
+        svc.after_step(step, state_s)
+        versions.append(svc.client.installed_version)
+
+        out_i, state_i = apply(kfac_i, grads, state_i, a, g,
+                               update_factors=(step % FAC == 0),
+                               update_eigen=(step % KF == 1))
+
+        ls = sorted(
+            (jax.tree_util.keystr(p), v)
+            for p, v in jax.tree_util.tree_leaves_with_path(out_s)
+        )
+        li = sorted(
+            (jax.tree_util.keystr(p), v)
+            for p, v in jax.tree_util.tree_leaves_with_path(out_i)
+        )
+        for (k, vs), (_, vi) in zip(ls, li):
+            np.testing.assert_allclose(
+                np.asarray(vs), np.asarray(vi), rtol=1e-6, atol=0,
+                err_msg=f"step {step} leaf {k}")
+
+    # install versions are monotone non-decreasing and advance once per
+    # refresh interval after the first boundary
+    assert versions == sorted(versions)
+    assert versions[0] == -1 and versions[-1] >= 2
+
+
+def test_service_staleness_budget_slips_then_installs():
+    """With budget 1 the client does not block at step s+1; the basis lands
+    by the deadline s+2 and the recorded slip is bounded by the budget."""
+    params = _dense_params(np.random.RandomState(0), [4, 3])
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+                service_devices=1)
+    state = kfac.init(params)
+    svc = CurvatureService(kfac, worker_devices=(),
+                           async_worker=False, staleness_budget=1)
+    a, g, grads = _stats_for(params, np.random.RandomState(5))
+
+    def capture(state, step):
+        _, s2 = kfac.update(grads, state, a_contribs=a, g_factor_stats=g,
+                            lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+                            update_factors=True, update_eigen=False)
+        return s2
+
+    # boundary step 0: publish; the worker is synchronous so the basis is
+    # complete immediately, but the client may still slip installs
+    state = capture(state, 0)
+    svc.after_step(0, state)
+    state = svc.before_step(1, state)
+    v_after_1 = svc.client.installed_version
+    state = svc.before_step(2, state)
+    assert svc.client.installed_version == 1
+    assert v_after_1 in (-1, 1)  # install at s+1 allowed, never required
+    from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+    slip = get_telemetry().gauges.get("kfac/basis_staleness_steps")
+    if slip is not None:
+        assert slip <= 1.0
+
+
+# -- cadence integration ------------------------------------------------
+
+
+def test_cadence_service_branch_never_fires_refresh_flags():
+    kfac = KFAC(damping=0.01, fac_update_freq=2, kfac_update_freq=4,
+                service_devices=1)
+    cad = EigenRefreshCadence(kfac)
+    for step in range(10):
+        fl = cad.flags_for_step(step)
+        assert fl["update_eigen"] is False
+        assert fl.get("eigen_chunk") is None
+        assert not fl.get("swap_eigen", False)
+        assert fl["update_factors"] == (step % 2 == 0)
+
+
+def test_cadence_state_dict_carries_service_bookkeeping():
+    kfac = KFAC(damping=0.01, service_devices=1)
+    cad = EigenRefreshCadence(kfac)
+    cad.note_basis_installed(version=3, step=5, slip=1)
+    d = cad.state_dict()
+    assert json.loads(json.dumps(d)) == d  # snapshot-manifest serializable
+    cad2 = EigenRefreshCadence(kfac)
+    cad2.load_state_dict(d)
+    assert cad2._basis_version == 3
+    assert cad2._basis_installed_step == 5
+    assert cad2._basis_slip == 1
+    assert cad2._bootstrapped is True
+    assert cad2._last_refresh_step == 5
+
+
+# -- worker liveness ----------------------------------------------------
+
+
+def test_supervisor_worker_beat(tmp_path):
+    from kfac_pytorch_tpu import elastic
+
+    sup = elastic.Supervisor(str(tmp_path), liveness_window_s=60.0)
+    sup.worker_beat(version=2, min_interval_s=0.0)
+    path = os.path.join(str(tmp_path), "heartbeats",
+                        f"worker-{jax.process_index()}.json")
+    with open(path) as fh:
+        beat = json.load(fh)
+    assert beat["role"] == "curvature-worker"
+    assert beat["version"] == 2
+    assert sup.liveness() == 1
+
+    # rate limiting: a second beat inside the interval is dropped
+    sup.worker_beat(version=3, min_interval_s=60.0)
+    with open(path) as fh:
+        again = json.load(fh)
+    assert again["version"] == 2 and again["t"] == beat["t"]
+
+
+def test_worker_beats_through_supervisor_on_refresh(tmp_path):
+    from kfac_pytorch_tpu import elastic
+
+    params = _dense_params(np.random.RandomState(0), [4, 3])
+    kfac = KFAC(damping=0.01, service_devices=1)
+    state, _ = _captured_state(kfac, params)
+    sup = elastic.Supervisor(str(tmp_path), liveness_window_s=60.0)
+    factors_box, basis_box = DeviceMailbox("f"), DeviceMailbox("b")
+    worker = CurvatureWorker(kfac, factors_box, basis_box, supervisor=sup)
+    factors_box.publish(1, state["factors"])
+    assert worker.step() == 1
+    path = os.path.join(str(tmp_path), "heartbeats",
+                        f"worker-{jax.process_index()}.json")
+    with open(path) as fh:
+        beat = json.load(fh)
+    assert beat["version"] == 1 and beat["role"] == "curvature-worker"
