@@ -808,7 +808,8 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
 
 def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
                     d_model=512, n_heads=8, n_layers=4, vocab=2048,
-                    sgd_only=False, model_kwargs=None, kfac_kwargs=None):
+                    sgd_only=False, model_kwargs=None, kfac_kwargs=None,
+                    tensor_parallel=0, fsdp=0):
     """Transformer-LM arm: SGD step + (optionally) amortized K-FAC overhead.
 
     Sized so the attention cost is visible (seq 2048: naive materializes the
@@ -816,13 +817,32 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
     G factor (vocab side) stays cheap to eigendecompose at bench iters.
     ``model_kwargs`` reach ``transformer_lm.get_model`` (the -lm-embed arm
     turns on ``kfac_embedding``); ``kfac_kwargs`` reach the ``KFAC``
-    constructor (profile, factor_kernel, ...)."""
+    constructor (profile, factor_kernel, ...). ``tensor_parallel > 0`` is
+    the -tp arm: a genuine Megatron MLP split over the 3-D
+    data×fsdp×tensor mesh (kfac_pytorch_tpu/shardwise/), params placed via
+    ``shardwise.lm_param_shardings`` and the per-shard factor/eigen bytes
+    reported from the placement specs."""
     from kfac_pytorch_tpu import KFAC, capture
     from kfac_pytorch_tpu.models import transformer_lm
     from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
 
     model_kwargs = dict(model_kwargs or {})
     kfac_kwargs = dict(kfac_kwargs or {})
+    mesh = None
+    if tensor_parallel:
+        from kfac_pytorch_tpu.parallel.mesh import data_fsdp_tensor_mesh
+
+        need = max(1, fsdp) * tensor_parallel
+        if jax.device_count() < need or jax.device_count() % need:
+            return {"skipped":
+                    f"needs a device count divisible by {need} "
+                    f"(have {jax.device_count()})"}
+        mesh = data_fsdp_tensor_mesh(max(1, fsdp), tensor_parallel)
+        model_kwargs["tensor_parallel"] = tensor_parallel
+        kfac_kwargs.setdefault("mesh", mesh)
+        # batch rows shard over the data×fsdp slots
+        slots = mesh.shape["data"] * mesh.shape["fsdp"]
+        batch = ((batch + slots - 1) // slots) * slots
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, vocab, size=(batch, seq)).astype(np.int32))
     targets = jnp.asarray(rng.randint(0, vocab, size=(batch, seq)).astype(np.int32))
@@ -833,13 +853,33 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
     variables = model.init(jax.random.PRNGKey(0), tokens, train=True)
     params = variables["params"]
     tx = make_sgd(momentum=0.9, weight_decay=0.0)
+    shard_layers = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kfac_pytorch_tpu import shardwise
+
+        shard_layers = capture.discover_layers(model, tokens, train=True)
+        batch_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+        tokens = jax.device_put(tokens, batch_sh)
+        targets = jax.device_put(targets, batch_sh)
 
     def fresh_state(kfac):
         p = jax.tree_util.tree_map(jnp.copy, params)
-        return TrainState(
+        st = TrainState(
             step=jnp.zeros((), jnp.int32), params=p, batch_stats={},
             opt_state=tx.init(p), kfac_state=kfac.init(p) if kfac else None,
         )
+        if mesh is not None:
+            # shardwise placement contract (docs/SHARDING.md)
+            pshard = shardwise.lm_param_shardings(p, shard_layers, mesh)
+            kst = st.kfac_state
+            if kfac is not None:
+                kst = jax.device_put(kst, kfac.state_shardings(kst))
+            st = st.replace(params=None, kfac_state=None)
+            st = jax.device_put(st, NamedSharding(mesh, P()))
+            st = st.replace(params=jax.device_put(p, pshard), kfac_state=kst)
+        return st
 
     lr, damping = jnp.float32(0.1), jnp.float32(0.003)
     sgd_step = make_train_step(model, tx, None, train_kwargs={"train": True})
@@ -854,6 +894,7 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
         "attention": attn_name,
         "batch": batch, "seq": seq, "d_model": d_model,
         "n_layers": n_layers, "vocab": vocab,
+        "tensor_parallel": tensor_parallel or 1, "fsdp": max(0, fsdp),
         "sgd_ms": round(t_sgd * 1e3, 3),
         "sgd_ms_std": round(sd_sgd * 1e3, 3),
         "sgd_tok_per_s_chip": round(batch * seq / t_sgd, 1),
@@ -951,6 +992,21 @@ def _measure_lm_arm(attn_name, attn_fn, batch, seq, fac_freq, kfac_freq,
             for leaf in jax.tree_util.tree_leaves(
                 s_kfac.kfac_state.get(key, {}))
         ))
+    if mesh is not None:
+        # the -tp arm's headline facts: the per-device curvature footprint
+        # the shard lenses keep (each device stores only the factor/eigen
+        # blocks of the kernel shard it owns — docs/SHARDING.md) and the
+        # amortized cost ratio vs plain SGD on the same 3-D mesh
+        kst = s_kfac.kfac_state
+        specs = kfac.state_shardings(kst)
+        out["tensor_parallel"] = tensor_parallel
+        out["fsdp"] = max(1, fsdp)
+        out["mesh_shape"] = {k: int(v) for k, v in mesh.shape.items()}
+        out["overhead_vs_sgd"] = round(t_amort / t_sgd, 4)
+        out["factor_state_bytes_local"] = int(shardwise.state_bytes_local(
+            {"factors": kst["factors"]}, {"factors": specs["factors"]}, mesh))
+        out["eigen_table_bytes_local"] = int(shardwise.state_bytes_local(
+            {"eigen": kst["eigen"]}, {"eigen": specs["eigen"]}, mesh))
     return out
 
 
@@ -1225,6 +1281,12 @@ def _transformer_bench(fac_freq, kfac_freq):
         ("embed-kfac", best_attention_fn(), False,
          dict(model_kwargs=dict(kfac_embedding=True),
               kfac_kwargs=dict(profile="production"))),
+        # -tp: sharded-parameter K-FAC — Megatron-split MLPs over the 3-D
+        # data×fsdp×tensor mesh (kfac_pytorch_tpu/shardwise/); read
+        # factor_state_bytes_local / eigen_table_bytes_local (per-device
+        # curvature footprint) and overhead_vs_sgd from its record
+        ("tp-kfac", best_attention_fn(), False,
+         dict(tensor_parallel=2, fsdp=2)),
     ]
     for name, fn, sgd_only, extra in sub_arms:
         try:

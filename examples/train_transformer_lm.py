@@ -97,6 +97,22 @@ def parse_args(argv=None):
                         "collective — factor buckets, owner reduce-scatter, "
                         "the preconditioned-grad allgather — rides the "
                         "'data' axis only; incompatible with --seq-parallel")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="engage the sharded-parameter regime over the 3-D "
+                        "data×fsdp×tensor mesh (parallel/mesh.py "
+                        "data_fsdp_tensor_mesh): params shard over 'fsdp' "
+                        "(leading-dim FSDP split) and — when "
+                        "--tensor-parallel > 1 — the MLP kernels GENUINELY "
+                        "shard over 'tensor' (Megatron column/row split, "
+                        "per-shard K-FAC blocks; docs/SHARDING.md). 0 keeps "
+                        "the legacy replicated-compute meshes; >= 1 is the "
+                        "'fsdp' axis size (1 = tensor-sharding only)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="replace each block's dense MLP with a toy top-1 "
+                        "MoE bank of this many experts (models/layers.py "
+                        "KFACMoE): per-expert A/G factors with token-count-"
+                        "weighted EMAs; 0 keeps the dense MLP; mutually "
+                        "exclusive with a genuine tensor-parallel MLP")
     p.add_argument("--attention", choices=["ring", "ulysses"], default="ring")
     # K-FAC (same surface as the CNN trainers)
     p.add_argument("--remat", action="store_true",
@@ -237,16 +253,36 @@ def main(argv=None):
     devices = np.asarray(jax.devices())
     sp = args.seq_parallel
     tp = args.tensor_parallel
+    fsdp = max(0, args.fsdp)
+    # --fsdp >= 1 flips --tensor-parallel's meaning from "replicated-compute
+    # second axis" (legacy 2-D data×tensor mesh) to GENUINE shard-lens
+    # tensor parallelism over the 3-D mesh (kfac_pytorch_tpu/shardwise/)
+    shardwise_regime = fsdp >= 1
     if sp > 1 and tp > 1:
         raise SystemExit(
             "--seq-parallel and --tensor-parallel are separate second mesh "
             "axes; pick one"
+        )
+    if shardwise_regime and sp > 1:
+        raise SystemExit(
+            "--fsdp builds the 3-D data×fsdp×tensor mesh; it does not "
+            "compose with --seq-parallel"
         )
     if devices.size % sp != 0:
         raise SystemExit(f"--seq-parallel {sp} must divide device count {devices.size}")
     if devices.size % max(1, tp) != 0:
         raise SystemExit(
             f"--tensor-parallel {tp} must divide device count {devices.size}"
+        )
+    if shardwise_regime and devices.size % (fsdp * max(1, tp)) != 0:
+        raise SystemExit(
+            f"--fsdp {fsdp} x --tensor-parallel {tp} must divide device "
+            f"count {devices.size}"
+        )
+    if args.moe_experts > 0 and shardwise_regime and tp > 1:
+        raise SystemExit(
+            "--moe-experts replaces the MLP that a genuine --tensor-parallel "
+            "split (--fsdp >= 1) would shard; pick one"
         )
     if args.seq_len % sp != 0:
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by --seq-parallel {sp}")
@@ -273,6 +309,8 @@ def main(argv=None):
     )
     if sp > 1:
         lever_axes = ("data", "seq")
+    elif shardwise_regime:
+        lever_axes = ("data", "fsdp", "tensor")
     elif tp > 1:
         lever_axes = ("data", "tensor")
     else:
@@ -280,12 +318,18 @@ def main(argv=None):
     lever_env = planner.PlanEnv(
         # the carved curvature workers are not part of the training world
         world=int(devices.size) - max(0, args.service_devices),
+        # factor replicas span the batch axes only: on the 3-D mesh that is
+        # data×fsdp (the tensor axis holds distinct kernel shards, not
+        # replicas); 0 keeps the legacy "same as world" meaning
+        data_world=(devices.size // max(1, tp)) if shardwise_regime else 0,
         # a REAL seq axis is what the owner/comm levers cannot ride; the
         # tensor axis is replicated-compute and passes pure_dp
         mesh_axes=lever_axes,
         track_diagnostics=args.kfac_diagnostics,
         has_diag_a_layers=args.kfac_embedding,
         has_conv_layers=False,
+        has_shard_lens_layers=bool(shardwise_regime and tp > 1),
+        has_moe_layers=args.moe_experts > 0,
         fac_update_freq=max(1, args.kfac_cov_update_freq),
         kfac_update_freq=max(1, args.kfac_update_freq),
         service_devices=args.service_devices,
@@ -301,15 +345,25 @@ def main(argv=None):
     # --tensor-parallel builds the 2-D data×tensor mesh (replicated-compute
     # tensor axis, K-FAC collectives on 'data' only)
     service_workers = ()
-    if args.service_devices > 0 and (sp > 1 or tp > 1):
+    if args.service_devices > 0 and (sp > 1 or tp > 1 or shardwise_regime):
         raise SystemExit(
             "--service-devices carves a pure data-parallel mesh; it does "
-            "not compose with --seq-parallel or --tensor-parallel"
+            "not compose with --seq-parallel, --tensor-parallel or --fsdp"
         )
     if sp > 1:
         mesh = Mesh(devices.reshape(devices.size // sp, sp), ("data", "seq"))
         batch_spec = P("data", "seq")
         dp = devices.size // sp
+    elif shardwise_regime:
+        from kfac_pytorch_tpu.parallel.mesh import data_fsdp_tensor_mesh
+
+        # 3-D data×fsdp×tensor mesh: batch rows spread over BOTH batch axes
+        # (fsdp slots see distinct examples — parameter sharding, not
+        # replication), kernels shard over 'tensor' via
+        # shardwise.lm_param_shardings below
+        mesh = data_fsdp_tensor_mesh(fsdp, max(1, tp), devices=devices)
+        batch_spec = P(("data", "fsdp"))
+        dp = devices.size // (fsdp * max(1, tp))
     elif tp > 1:
         from kfac_pytorch_tpu.parallel.mesh import data_tensor_mesh
 
@@ -329,19 +383,22 @@ def main(argv=None):
         mesh = Mesh(devices, ("data",))
         batch_spec = P("data")
         dp = devices.size
+    # batch rows shard over every batch axis: data only on the legacy
+    # meshes, data×fsdp on the 3-D mesh
+    batch_world = dp * fsdp if shardwise_regime else dp
     n_proc = launch.size()
-    if dp % n_proc != 0:
-        # per-process row-block slicing below assumes the data axis spans
+    if batch_world % n_proc != 0:
+        # per-process row-block slicing below assumes the batch axes span
         # processes contiguously; a seq axis spanning hosts needs a
         # different feed layout
         raise SystemExit(
-            f"data-axis size {dp} must be divisible by process count "
-            f"{n_proc} (lower --seq-parallel so the sequence axis does not "
-            "span hosts)"
+            f"batch-axes size {batch_world} must be divisible by process "
+            f"count {n_proc} (lower --seq-parallel so the sequence axis "
+            "does not span hosts)"
         )
-    global_bs = args.batch_size * dp
+    global_bs = args.batch_size * batch_world
     if launch.is_primary():
-        print(f"mesh data={dp} seq={sp} tensor={tp} "
+        print(f"mesh data={dp} fsdp={fsdp} seq={sp} tensor={tp} "
               f"global_batch={global_bs} seq_len={args.seq_len}")
 
     if sp > 1:
@@ -370,6 +427,10 @@ def main(argv=None):
         n_heads=args.n_heads, n_layers=args.n_layers, attention_fn=attn,
         kfac_embedding=args.kfac_embedding, qkv_lens=args.qkv_lens,
         tie_embeddings=args.tie_embeddings, remat=args.remat,
+        # legacy --tensor-parallel replicates compute, so the model stays
+        # dense; the shardwise regime makes it a genuine Megatron MLP split
+        tensor_parallel=tp if shardwise_regime else 1,
+        moe_experts=args.moe_experts,
     )
     init_toks = jnp.zeros((global_bs, args.seq_len), jnp.int32)
     variables = model.init(jax.random.PRNGKey(args.seed), init_toks, train=True)
@@ -492,6 +553,26 @@ def main(argv=None):
         state = state.replace(kfac_state=None)
         state = jax.device_put(state, NamedSharding(mesh, P()))
         state = state.replace(kfac_state=kstate)
+    elif shardwise_regime and devices.size > 1:
+        # shardwise placement contract (docs/SHARDING.md): kernels split
+        # over tensor/fsdp (shardwise.lm_param_shardings), each per-shard
+        # factor/eigen block on the devices holding the matching kernel
+        # shard (KFAC.state_shardings); step counter, optimizer trace and
+        # the remaining factors replicate
+        from kfac_pytorch_tpu import shardwise
+
+        shard_names = (
+            kfac_layers if use_kfac
+            else capture.discover_layers(model, init_toks, train=True)
+        )
+        pshard = shardwise.lm_param_shardings(state.params, shard_names, mesh)
+        sharded_params = jax.device_put(state.params, pshard)
+        kstate = state.kfac_state
+        if kfac is not None:
+            kstate = jax.device_put(kstate, kfac.state_shardings(kstate))
+        state = state.replace(params=None, kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(params=sharded_params, kfac_state=kstate)
     else:
         state = jax.device_put(state, NamedSharding(mesh, P()))
 

@@ -16,9 +16,14 @@ import jax
 import jax.numpy as jnp
 
 from kfac_pytorch_tpu.models.layers import (
+    A_COL,
     A_CONTRIB,
+    A_MOE,
+    A_ROW,
     A_SPLIT,
     G_TIED,
+    N_MOE,
+    OUT_MOE,
     OUT_PERTURB,
     OUT_TIED,
 )
@@ -41,6 +46,42 @@ GROUP_SEP = "#g"
 # output/G side into features/S columns.
 SPLIT_SEP = "#s"
 
+# Shard-lens naming (kfac_pytorch_tpu/shardwise/): unlike "#gK"/"#sK" (one
+# pseudo-layer per index), ONE name carries the whole shard stack — the
+# per-shard factors stay stacked in state so the tensor-axis layout
+# (shardwise.lenses) can place each block on the device that owns the
+# matching kernel shard.
+#   "path#c{T}"  column-sharded dense (T kernel column shards): replicated A,
+#                block-diagonal per-shard G stack [T, m/T, m/T].
+#   "path#r{T}"  row-sharded dense (T kernel row shards): per-shard A slices
+#                [T, a/T, a/T], one shared G (the psum'd output grad).
+#   "path#e{E}"  MoE expert bank (E experts): per-expert A/G stacks with
+#                token-count-weighted EMAs.
+COL_SEP = "#c"
+ROW_SEP = "#r"
+MOE_SEP = "#e"
+_SHARD_SEPS = {"c": COL_SEP, "r": ROW_SEP, "e": MOE_SEP}
+
+
+def split_shard_name(name: str) -> Tuple[str, Any, Any]:
+    """``"path#c4" -> ("path", "c", 4)``; unsharded ``-> (name, None, None)``.
+
+    The form tag is ``"c"`` (column-sharded), ``"r"`` (row-sharded) or
+    ``"e"`` (MoE expert bank); the count is the shard/expert count the layer
+    sowed (NOT a pseudo-layer index — shard stacks are never expanded into
+    per-index entries).
+    """
+    for form, sep in _SHARD_SEPS.items():
+        base, s, count = name.rpartition(sep)
+        if s and count.isdigit():
+            return base, form, int(count)
+    return name, None, None
+
+
+def is_shard_name(name: str) -> bool:
+    """Whether ``name`` carries a shard-lens suffix (``#c``/``#r``/``#e``)."""
+    return split_shard_name(name)[1] is not None
+
 
 def split_group_name(name: str) -> Tuple[str, Any]:
     """``"path#g3" -> ("path", 3)``; ungrouped ``"path" -> ("path", None)``."""
@@ -59,9 +100,13 @@ def split_lens_name(name: str) -> Tuple[str, Any]:
 
 
 def layer_base(name: str) -> str:
-    """Module path with any pseudo-layer suffix (``#gK``/``#sK``) stripped."""
+    """Module path with any pseudo-layer/shard suffix stripped
+    (``#gK``/``#sK``/``#cT``/``#rT``/``#eE``)."""
     base, gi = split_group_name(name)
     if gi is not None:
+        return base
+    base, form, _ = split_shard_name(name)
+    if form is not None:
         return base
     return split_lens_name(name)[0]
 
@@ -126,18 +171,25 @@ def layer_names_from_capture(captured: PyTree) -> List[str]:
     G ``path#gK`` pseudo-layers (rank 2 = dense/conv, rank 1 = embedding
     diagonal). An ``a_lens`` contribution ``[S, a, a]`` marks an expand-lens
     dense layer (fused QKV), expanded into S ``path#sK`` pseudo-layers.
+    A shard-lens contribution (``a_col``/``a_row``/``a_moe``) marks a
+    sharded-parameter layer and yields ONE ``path#cT``/``path#rT``/``path#eE``
+    name carrying the stack size in the suffix (shard stacks stay stacked).
     """
+    shard_keys = {A_COL: COL_SEP, A_ROW: ROW_SEP, A_MOE: MOE_SEP}
+    a_keys = (A_CONTRIB, A_SPLIT) + tuple(shard_keys)
     names = []
     for keys, leaf in _flatten_with_paths(captured):
         # sow may wrap the leaf in a tuple (path gains an index key)
-        key = keys[-1] if keys[-1] in (A_CONTRIB, A_SPLIT) else (
-            keys[-2] if len(keys) >= 2 and keys[-2] in (A_CONTRIB, A_SPLIT)
+        key = keys[-1] if keys[-1] in a_keys else (
+            keys[-2] if len(keys) >= 2 and keys[-2] in a_keys
             else None
         )
         if key is None:
             continue
         name = "/".join(keys[: -1 if keys[-1] == key else -2])
-        if key == A_SPLIT:
+        if key in shard_keys:
+            expanded = [f"{name}{shard_keys[key]}{leaf.shape[0]}"]
+        elif key == A_SPLIT:
             expanded = [f"{name}{SPLIT_SEP}{k}" for k in range(leaf.shape[0])]
         elif len(getattr(leaf, "shape", ())) == 3:
             expanded = [f"{name}{GROUP_SEP}{k}" for k in range(leaf.shape[0])]
@@ -186,6 +238,17 @@ def layer_grads(grads: PyTree, names: List[str]) -> Dict[str, Dict[str, jnp.ndar
     s_counts = lens_counts(names)
     out = {}
     for name in names:
+        sbase, form, _ = split_shard_name(name)
+        if form is not None:
+            # shard-lens layers: the whole (stacked) kernel grad rides under
+            # the ONE shard name — slicing happens in factor space
+            # (shardwise.lenses), where the shard blocks live
+            node = _get_path(grads, sbase)
+            entry = {"kernel": node["kernel"]}
+            if form == "c" and "bias" in node:
+                entry["bias"] = node["bias"]
+            out[name] = entry
+            continue
         base, gi = split_group_name(name)
         si = None
         if gi is None:
@@ -249,6 +312,33 @@ def a_contribs(
             s_present[b] = s_present.get(b, 0) + 1
     out = {}
     for name in names:
+        shbase, form, count = split_shard_name(name)
+        if form is not None:
+            node = _get_path(captured, shbase)
+            key = {"c": A_COL, "r": A_ROW, "e": A_MOE}[form]
+            leaf = _unwrap_sown(node[key])
+            if leaf.shape[0] != count:
+                raise ValueError(
+                    f"shard-lens layer {shbase!r}: name {name!r} declares "
+                    f"{count} shards but the layer sowed a "
+                    f"[{leaf.shape[0]}, ...] stack — rebuild the layer list "
+                    "with capture.discover_layers"
+                )
+            if form == "c":
+                # replicated A: the sow broadcasts one [a, a] contribution
+                # into a [T, a, a] stack purely to carry T; read row 0
+                out[name] = leaf[0]
+            elif form == "r":
+                out[name] = leaf  # per-shard A slices [T, a/T, a/T]
+            else:
+                # MoE: the UNNORMALIZED per-expert sums plus the token
+                # fraction vector ride together so the comm plane pmeans
+                # both (the weighted EMA normalizes after the reduction)
+                out[name] = {
+                    "S": leaf,
+                    "f": _unwrap_sown(node[N_MOE]),
+                }
+            continue
         base, gi = split_group_name(name)
         if gi is None:
             sbase, si = split_lens_name(name)
@@ -352,6 +442,31 @@ def g_factors(
     s_counts = lens_counts(names)
     out = {}
     for name in names:
+        shbase, form, count = split_shard_name(name)
+        if form is not None:
+            node = _get_path(perturb_grads, shbase)
+            if form == "c":
+                # block-diagonal G: one covariance per kernel column shard
+                out[name] = factors.compute_g_dense_sharded(
+                    node[OUT_PERTURB].astype(jnp.float32),
+                    count,
+                    batch_averaged=batch_averaged,
+                )
+            elif form == "r":
+                # row-sharded: every shard sees the same (psum'd) output
+                # grad — ONE shared G factor
+                out[name] = factors.compute_g_dense(
+                    node[OUT_PERTURB].astype(jnp.float32),
+                    batch_averaged=batch_averaged,
+                )
+            else:
+                # MoE: the [.., E, m] perturbation cotangent is already
+                # expert-masked by the top-1 routing
+                out[name] = factors.compute_g_moe(
+                    node[OUT_MOE].astype(jnp.float32),
+                    batch_averaged=batch_averaged,
+                )
+            continue
         base, gi = split_group_name(name)
         if gi is not None:
             out[name] = stacked[base][gi]
@@ -406,8 +521,18 @@ def split_factor_stat_tree(
 def grad_mats(
     lgrads: Dict[str, Dict[str, jnp.ndarray]]
 ) -> Dict[str, jnp.ndarray]:
-    """Per-layer factor-space gradient matrices ``[out, in(+1)]``."""
-    return {name: factors.grads_to_mat(g) for name, g in lgrads.items()}
+    """Per-layer factor-space gradient matrices ``[out, in(+1)]``.
+
+    MoE expert banks (``#eE`` names, rank-3 ``[E, a, m]`` kernels) become
+    stacked ``[E, m, a]`` matrices — one factor-space mat per expert.
+    """
+    out = {}
+    for name, g in lgrads.items():
+        if split_shard_name(name)[1] == "e":
+            out[name] = jnp.transpose(g["kernel"], (0, 2, 1))
+        else:
+            out[name] = factors.grads_to_mat(g)
+    return out
 
 
 def write_back(
@@ -428,6 +553,24 @@ def write_back(
     grouped: Dict[str, Dict[int, jnp.ndarray]] = {}
     lensed: Dict[str, Dict[int, jnp.ndarray]] = {}
     for name, mat in updates.items():
+        shbase, form, _ = split_shard_name(name)
+        if form is not None:
+            node = _get_path(grads, shbase)
+            if form == "e":
+                # stacked [E, m, a] expert updates back to the [E, a, m] bank
+                node["kernel"] = jnp.transpose(mat * nu, (0, 2, 1)).astype(
+                    node["kernel"].dtype
+                )
+                continue
+            # column/row-sharded dense: the update is a full-width
+            # [m, a(+1)] mat (shard blocks were merged in factor space)
+            new = factors.mat_to_grads(
+                mat * nu, node["kernel"].shape, has_bias="bias" in node
+            )
+            node["kernel"] = new["kernel"].astype(node["kernel"].dtype)
+            if "bias" in node:
+                node["bias"] = new["bias"].astype(node["bias"].dtype)
+            continue
         base, gi = split_group_name(name)
         if gi is not None:
             grouped.setdefault(base, {})[gi] = mat

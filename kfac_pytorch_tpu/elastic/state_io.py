@@ -206,29 +206,50 @@ def pack_replica_local(state: Any, mesh: Any = None) -> Tuple[Any, bool]:
     partial sums and break bitwise mid-flush-window resume. Packing reads
     every device's shard (in mesh order when ``mesh`` is given) while the
     live arrays are still addressable; :func:`unpack_replica_local` puts
-    each row back on its device at restore. Multi-process runs skip the
-    pack (cross-host shards are not addressable here): snapshot on a flush
-    boundary to make deferred accumulation lossless there.
+    each row back on its device at restore.
+
+    Multi-process runs cannot host-stack (cross-host shards are not
+    addressable here), so the pack instead builds a GLOBAL ``(world, ...)``
+    array sharded one-row-per-device over a flat mesh of the same devices:
+    each process contributes only the rows it can address
+    (``make_array_from_single_device_arrays``), and the multi-process
+    :func:`save_pytree` branch hands orbax that live global array so every
+    host writes its own replicas' accumulators — deferred accumulation is
+    lossless off flush boundaries across hosts too.
     """
     kstate = kfac_state_of(state)
     if kstate is None or "factor_local" not in kstate:
         return state, False
-    if jax.process_count() > 1:
-        return state, False
     leaves = jax.tree_util.tree_leaves(kstate["factor_local"])
     if not leaves or not hasattr(leaves[0], "addressable_shards"):
         return state, False  # already host-side: per-replica info is gone
-    order = (
-        {d.id: i for i, d in enumerate(mesh.devices.flat)}
-        if mesh is not None else None
+    devs = (
+        list(mesh.devices.flat) if mesh is not None
+        else sorted(jax.devices(), key=lambda d: d.id)
     )
+    order = {d.id: i for i, d in enumerate(devs)}
 
-    def pack(x):
-        shards = sorted(
-            x.addressable_shards,
-            key=lambda s: order[s.device.id] if order else s.device.id,
-        )
-        return np.stack([np.asarray(s.data) for s in shards])
+    if jax.process_count() > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        flat = Mesh(np.asarray(devs), ("packed",))
+        row_sharding = NamedSharding(flat, PartitionSpec("packed"))
+
+        def pack(x):
+            shards = sorted(
+                x.addressable_shards, key=lambda s: order[s.device.id]
+            )
+            rows = [s.data.reshape((1,) + tuple(s.data.shape))
+                    for s in shards]
+            return jax.make_array_from_single_device_arrays(
+                (len(devs),) + tuple(x.shape), row_sharding, rows
+            )
+    else:
+        def pack(x):
+            shards = sorted(
+                x.addressable_shards, key=lambda s: order[s.device.id]
+            )
+            return np.stack([np.asarray(s.data) for s in shards])
 
     local = jax.tree_util.tree_map(pack, kstate["factor_local"])
     return _with_kfac_state(state, {**kstate, "factor_local": local}), True
@@ -251,7 +272,9 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
     """Inverse of :func:`pack_replica_local` on the same-size mesh: row i of
     each packed leaf becomes mesh device i's replica-local copy again (a
     replicated-spec array with deliberately divergent shards — exactly the
-    form the live deferred accumulation produces)."""
+    form the live deferred accumulation produces). Multi-process: each
+    process puts only the rows of its own addressable devices (the restored
+    packed array is host-replicated, so every host sees all rows)."""
     kstate = kfac_state_of(state)
     if kstate is None or "factor_local" not in kstate:
         return state
@@ -259,6 +282,7 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
 
     devs = list(mesh.devices.flat)
     spec = NamedSharding(mesh, PartitionSpec())
+    mine = jax.process_index()
 
     def unpack(x):
         x = np.asarray(jax.device_get(x))
@@ -267,7 +291,8 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
                 f"packed factor_local world {x.shape[0]} != mesh size "
                 f"{len(devs)} — resize replans drop deferred accumulators"
             )
-        bufs = [jax.device_put(x[i], d) for i, d in enumerate(devs)]
+        bufs = [jax.device_put(x[i], d) for i, d in enumerate(devs)
+                if d.process_index == mine]
         return jax.make_array_from_single_device_arrays(
             x.shape[1:], spec, bufs
         )
@@ -305,6 +330,14 @@ def save_snapshot(
         )
     manifest = build_manifest(state, kfac=kfac, cadence=cadence, extra=extra)
     manifest["packed_replica_local"] = bool(packed_replica_local)
+    if packed_replica_local:
+        rows = jax.tree_util.tree_leaves(
+            (kfac_state_of(state) or {}).get("factor_local", {})
+        )
+        if rows:
+            # rows = mesh size (every device's replica accumulator), which
+            # a 3-D mesh makes distinct from "world" (= data×fsdp replicas)
+            manifest["packed_world"] = int(rows[0].shape[0])
     if manifest["step"] is None:
         manifest["step"] = int(step)
     snap = snapshot_dir(directory, step)
@@ -383,8 +416,10 @@ def restore_snapshot(
     """
     manifest = load_manifest(snap)
     packed = bool(manifest.get("packed_replica_local"))
-    if packed and manifest.get("world"):
-        target = stack_local_template(target, int(manifest["world"]))
+    if packed and (manifest.get("packed_world") or manifest.get("world")):
+        target = stack_local_template(
+            target, int(manifest.get("packed_world") or manifest["world"])
+        )
     state = restore_pytree(os.path.join(snap, STATE_SUBDIR), target)
     kstate = kfac_state_of(state)
     validate_state_keys(kstate)

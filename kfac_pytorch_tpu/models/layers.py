@@ -31,6 +31,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -55,6 +56,18 @@ A_SPLIT = "a_lens"
 # shared table accumulates both uses once.
 G_TIED = "g_tied"
 OUT_TIED = "out_tied"
+# Shard-lens capture (kfac_pytorch_tpu/shardwise/): sharded-parameter dense
+# layers sow distinct variables so capture.py can read the shard FORM (not
+# just a count) off the key. A_COL is a broadcast [T, a, a] stack (replicated
+# A, T carried in the leading dim); A_ROW is a genuine [T, a/T, a/T] stack of
+# per-slice covariances; A_MOE is the [E, a, a] per-expert sum stack with
+# N_MOE the [E] token-fraction vector alongside; OUT_MOE perturbs the dense
+# [.., E, m] per-expert output so its cotangent is already expert-masked.
+A_COL = "a_col"
+A_ROW = "a_row"
+A_MOE = "a_moe"
+N_MOE = "n_moe"
+OUT_MOE = "out_moe"
 
 
 def _overwrite(old: Any, new: Any) -> Any:
@@ -167,6 +180,187 @@ class KFACDense(_KFACLayer):
         if bias is not None:
             y = y + bias.astype(y.dtype)
         return self._maybe_perturb(y)
+
+
+class KFACShardedDense(_KFACLayer):
+    """Dense layer whose kernel is SHARDED over a tensor-parallel axis, with
+    per-shard K-FAC capture (kfac_pytorch_tpu/shardwise/).
+
+    The compute is an ordinary ``y = x @ kernel (+ bias)`` — GSPMD shards it
+    when the trainer places the kernel with
+    ``shardwise.lm_param_shardings`` over a mesh with a genuine
+    compute-sharded ``tensor`` axis (``parallel.mesh.data_fsdp_tensor_mesh``).
+    What changes is the CURVATURE model (arxiv 2311.00636 lens algebra):
+
+    * ``sharding="column"`` (kernel ``[a, m]`` split along m): every shard
+      reads the full input, so A is replicated; the shards' outputs are
+      disjoint, so G is exactly block-diagonal — captured as a ``[T, m/T,
+      m/T]`` stack, preconditioned shard-locally with ZERO extra collectives
+      on the tensor axis (scripts/check_collective_count.py pins this).
+    * ``sharding="row"`` (kernel split along a): each shard reads its own
+      input slice → per-shard A stack ``[T, a/T, a/T]``; the output-grad is
+      shared (the forward's psum), so ONE G factor. ``use_bias`` must stay
+      False — a row-sharded bias is not attributable to one input shard.
+
+    Captured as ONE ``name#c{T}``/``name#r{T}`` layer whose factors stay
+    stacked (capture.split_shard_name), unlike the per-index ``#sK``
+    expansion of the fused-QKV lens.
+    """
+
+    features: int
+    shards: int
+    sharding: str = "column"
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.sharding not in ("column", "row"):
+            raise ValueError(
+                f"sharding={self.sharding!r} must be 'column' or 'row'"
+            )
+        if self.shards < 1:
+            raise ValueError(f"shards={self.shards} must be >= 1")
+        if self.sharding == "column":
+            if self.features % self.shards:
+                raise ValueError(
+                    f"column sharding needs shards={self.shards} to divide "
+                    f"features={self.features}"
+                )
+        else:
+            if x.shape[-1] % self.shards:
+                raise ValueError(
+                    f"row sharding needs shards={self.shards} to divide the "
+                    f"input width {x.shape[-1]}"
+                )
+            if self.use_bias:
+                raise ValueError(
+                    "row-sharded layers cannot carry a bias: the bias is "
+                    "not attributable to one input shard — set "
+                    "use_bias=False"
+                )
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, (self.features,), self.param_dtype
+            )
+        else:
+            bias = None
+
+        if self._capturing():
+            if self.sharding == "column":
+                # replicated A, broadcast-stacked [T, a(+1), a(+1)] so
+                # capture.py reads T off the leading dim (XLA CSEs the
+                # broadcast — no extra matmul, like the lens-split sow)
+                contrib = factors.compute_a_dense(
+                    x.astype(jnp.float32), has_bias=self.use_bias
+                )
+                self.sow(
+                    KFAC_ACTS,
+                    A_COL,
+                    jnp.broadcast_to(
+                        contrib[None], (self.shards,) + contrib.shape
+                    ),
+                    reduce_fn=_overwrite,
+                )
+            else:
+                self.sow(
+                    KFAC_ACTS,
+                    A_ROW,
+                    factors.compute_a_row_sharded(
+                        x.astype(jnp.float32), self.shards
+                    ),
+                    reduce_fn=_overwrite,
+                )
+
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        y = jnp.matmul(x, kernel)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return self._maybe_perturb(y)
+
+
+class KFACMoE(_KFACLayer):
+    """Toy mixture-of-experts bank (top-1 routing) with per-expert K-FAC.
+
+    ``E`` experts share one ``[E, a, m]`` kernel bank; a bias-free router
+    picks one expert per token (its gate probability scales the output, so
+    the router itself trains by plain SGD through the gate). The curvature
+    model is the MoE expert lens: per-expert A/G factor stacks whose EMAs
+    are token-count-weighted (experts that saw no tokens keep their history
+    untouched) — maintained by the preconditioner from the sown
+    UNNORMALIZED per-expert sums plus the ``[E]`` token-fraction vector, so
+    every sown leaf stays linear in per-token contributions and the
+    cross-replica pmean is exact.
+
+    The ``[tokens, experts]`` dispatch one-hot never densifies: fractions
+    ride the sparse embedding-bincount kernel
+    (``dispatch_compute_a_moe``), and the per-expert covariance sums mask
+    with [N] booleans (``factors.compute_a_moe``). Captured as ONE
+    ``name#e{E}`` layer.
+    """
+
+    features: int
+    num_experts: int
+    dtype: Optional[Dtype] = None
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.num_experts < 2:
+            raise ValueError(
+                f"num_experts={self.num_experts} must be >= 2 (use KFACDense "
+                "for a single expert)"
+            )
+        a = x.shape[-1]
+        lead = x.shape[:-1]
+        xf = x.reshape(-1, a)
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (self.num_experts, a, self.features),
+            self.param_dtype,
+        )
+        logits = nn.Dense(
+            self.num_experts, use_bias=False, name="router",
+            param_dtype=self.param_dtype,
+        )(xf)
+        idx = jnp.argmax(logits, axis=-1)  # [N] top-1 expert ids
+        gate = jnp.take_along_axis(
+            jax.nn.softmax(logits, axis=-1), idx[:, None], axis=-1
+        )  # [N, 1]
+
+        if self._capturing():
+            self.sow(
+                KFAC_ACTS,
+                A_MOE,
+                factors.compute_a_moe(
+                    xf.astype(jnp.float32), idx, self.num_experts
+                ),
+                reduce_fn=_overwrite,
+            )
+            self.sow(
+                KFAC_ACTS,
+                N_MOE,
+                factor_kernels.dispatch_compute_a_moe(idx, self.num_experts),
+                reduce_fn=_overwrite,
+            )
+
+        xf, kernel = nn.dtypes.promote_dtype(xf, kernel, dtype=self.dtype)
+        # dense per-expert outputs [N, E, m] (toy scale); perturbing THIS
+        # tensor makes the cotangent expert-masked for free: only the
+        # selected expert's row feeds y, so ∂L/∂h is zero elsewhere
+        h = jnp.einsum("na,eam->nem", xf, kernel)
+        h = self._maybe_perturb(h, OUT_MOE)
+        sel = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0, :]
+        y = gate.astype(sel.dtype) * sel
+        return y.reshape(lead + (self.features,))
 
 
 class KFACEmbed(_KFACLayer):

@@ -21,7 +21,12 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from kfac_pytorch_tpu.models.layers import KFACDense, KFACEmbed
+from kfac_pytorch_tpu.models.layers import (
+    KFACDense,
+    KFACEmbed,
+    KFACMoE,
+    KFACShardedDense,
+)
 from kfac_pytorch_tpu.parallel.context import full_attention
 
 AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v, causal=...) -> out
@@ -40,9 +45,25 @@ class TransformerBlock(nn.Module):
     # 3·d_model-side factor — ~9× lighter eigendecompositions, and the
     # factors land in the same shape buckets as the other projections.
     qkv_lens: bool = False
+    # Tensor-parallel MLP (kfac_pytorch_tpu/shardwise/): ff1 column-sharded,
+    # ff2 row-sharded (bias-free) over ``tensor_parallel`` shards — the
+    # Megatron MLP split, each kernel preconditioned per shard block. Place
+    # the params with shardwise.lm_param_shardings over a
+    # data_fsdp_tensor_mesh to actually distribute the compute.
+    tensor_parallel: int = 1
+    # Replace the dense MLP with a toy top-1 MoE bank (KFACMoE) of this
+    # many experts; 0 keeps the dense MLP. Mutually exclusive with
+    # tensor_parallel > 1 (the expert bank is not tensor-sharded).
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        if self.tensor_parallel > 1 and self.moe_experts > 0:
+            raise ValueError(
+                "tensor_parallel > 1 and moe_experts > 0 are mutually "
+                "exclusive: the MoE expert bank replaces the MLP the "
+                "tensor-parallel split would shard"
+            )
         b, t, _ = x.shape
         hd = self.d_model // self.n_heads
 
@@ -63,9 +84,22 @@ class TransformerBlock(nn.Module):
         x = x + a
 
         h = nn.LayerNorm(name="ln_mlp")(x)
-        f = KFACDense(self.d_ff, name="ff1")(h)
-        f = nn.gelu(f)
-        f = KFACDense(self.d_model, name="ff2")(f)
+        if self.moe_experts > 0:
+            f = KFACMoE(self.d_model, self.moe_experts, name="moe")(h)
+        elif self.tensor_parallel > 1:
+            f = KFACShardedDense(
+                self.d_ff, self.tensor_parallel, sharding="column",
+                name="ff1",
+            )(h)
+            f = nn.gelu(f)
+            f = KFACShardedDense(
+                self.d_model, self.tensor_parallel, sharding="row",
+                use_bias=False, name="ff2",
+            )(f)
+        else:
+            f = KFACDense(self.d_ff, name="ff1")(h)
+            f = nn.gelu(f)
+            f = KFACDense(self.d_model, name="ff2")(f)
         if self.dropout:
             f = nn.Dropout(self.dropout, deterministic=not train)(f)
         return x + f
@@ -90,6 +124,9 @@ class TransformerLM(nn.Module):
     # Expand-lens on every block's fused QKV projection (see
     # TransformerBlock.qkv_lens).
     qkv_lens: bool = False
+    # Shardwise options, threaded per block (see TransformerBlock).
+    tensor_parallel: int = 1
+    moe_experts: int = 0
     # Weight tying: the decoder head reuses the token-embedding table
     # (logits = x · Wᵀ). With kfac_embedding=True the tied table gets ONE
     # set of K-FAC statistics accumulated over both use sites (the reduce
@@ -133,6 +170,8 @@ class TransformerLM(nn.Module):
                 attention_fn=self.attention_fn,
                 dropout=self.dropout,
                 qkv_lens=self.qkv_lens,
+                tensor_parallel=self.tensor_parallel,
+                moe_experts=self.moe_experts,
                 name=f"block_{i}",
             )(x, train)
         x = nn.LayerNorm(name="ln_f")(x)
@@ -153,6 +192,8 @@ def get_model(
     qkv_lens: bool = False,
     tie_embeddings: bool = False,
     remat: bool = False,
+    tensor_parallel: int = 1,
+    moe_experts: int = 0,
 ) -> TransformerLM:
     """Factory in the style of the other zoos (models/__init__.py)."""
     return TransformerLM(
@@ -163,4 +204,6 @@ def get_model(
         qkv_lens=qkv_lens,
         tie_embeddings=tie_embeddings,
         remat=remat,
+        tensor_parallel=tensor_parallel,
+        moe_experts=moe_experts,
     )

@@ -537,6 +537,26 @@ def dispatch_compute_a_embed(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
         return factors.compute_a_embed(ids, vocab)
 
 
+def dispatch_compute_a_moe(
+    expert_ids: jnp.ndarray, num_experts: int
+) -> jnp.ndarray:
+    """Expert token fractions ``counts_e / N`` for an MoE layer, per scope.
+
+    The ``[tokens, experts]`` dispatch one-hot is exactly the embedding
+    one-hot with ``vocab = num_experts``, so the MoE fraction vector rides
+    the same streamed Pallas bincount (``compute_a_embed_fused``) — the
+    one-hot never densifies in HBM on either path. Integer ids: no tangent
+    path, no ``stop_gradient`` needed.
+    """
+    tel = get_telemetry()
+    kind = active_factor_kernel()
+    tel.set_gauge("kfac/moe_dispatch_kernel", 1.0 if kind == "pallas" else 0.0)
+    with tel.span("trace/kfac/factor_kernel"):
+        if kind == "pallas":
+            return compute_a_embed_fused(expert_ids, num_experts)
+        return factors.compute_a_embed(expert_ids, num_experts)
+
+
 def dispatch_compute_a_conv_grouped(
     a: jnp.ndarray,
     groups: int,

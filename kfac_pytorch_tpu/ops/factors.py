@@ -149,6 +149,70 @@ def compute_a_conv_grouped(
     )(xg)
 
 
+def compute_a_row_sharded(a: jnp.ndarray, shards: int) -> jnp.ndarray:
+    """Per-shard input covariances for a ROW-sharded dense kernel: ``[T, a/T, a/T]``.
+
+    A row-sharded matmul ``y = Σ_s x_s W_s`` reads T disjoint feature slices
+    of its input; the shard lens models each slice as an independent
+    Kronecker pair, so the A side is the stack of per-slice covariances
+    (*KFAC for Modern Neural Network Architectures*, arxiv 2311.00636).
+    No bias column: the bias of a row-sharded layer is not attributable to
+    one input shard (layers force ``use_bias=False``). Scaling matches
+    :func:`compute_a_dense` (``/N`` rows).
+    """
+    a = _flatten_leading(a)
+    n = a.shape[0]
+    am = a.reshape(n, shards, a.shape[-1] // shards)
+    return jnp.einsum("nti,ntj->tij", am, am / n, precision=_HIGHEST)
+
+
+def compute_a_moe(
+    x: jnp.ndarray, expert_ids: jnp.ndarray, num_experts: int
+) -> jnp.ndarray:
+    """Per-expert UNNORMALIZED input-covariance sums: ``[E, a, a]``.
+
+    Expert ``e``'s slot holds ``S_e = (1/N)·Σ_{t: id_t=e} x_t x_tᵀ`` — the
+    covariance sum weighted by the GLOBAL 1/N (not per-expert token counts),
+    so the leaves stay linear in per-token contributions and a cross-replica
+    ``pmean`` of (S_e, f_e) pairs is exact; the token-count normalization
+    ``S_e / f_e`` happens at EMA time (preconditioner), after the reduction.
+
+    The [tokens, experts] dispatch one-hot never densifies: each expert's
+    rows are selected with a [N] boolean mask (same elementwise product the
+    dense one-hot oracle applies column-wise, so the two are bitwise equal).
+    """
+    x = _flatten_leading(x)
+    ids = expert_ids.reshape(-1)
+    n = x.shape[0]
+
+    def _one(e):
+        xm = x * (ids == e)[:, None].astype(x.dtype)
+        return jnp.matmul(xm.T, xm / n, precision=_HIGHEST)
+
+    return jnp.stack([_one(e) for e in range(num_experts)])
+
+
+def compute_a_moe_onehot(
+    x: jnp.ndarray, expert_ids: jnp.ndarray, num_experts: int
+) -> jnp.ndarray:
+    """Dense scatter-add oracle for :func:`compute_a_moe` (parity baseline).
+
+    Materializes the [N, E] dispatch one-hot and masks with its columns —
+    exactly the program the sparse path must never emit, kept as the
+    reference semantics for the bitwise MoE capture test.
+    """
+    x = _flatten_leading(x)
+    n = x.shape[0]
+    onehot = jax.nn.one_hot(
+        expert_ids.reshape(-1), num_experts, dtype=x.dtype
+    )
+    out = []
+    for e in range(num_experts):
+        xm = x * onehot[:, e][:, None]
+        out.append(jnp.matmul(xm.T, xm / n, precision=_HIGHEST))
+    return jnp.stack(out)
+
+
 def compute_a_embed(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
     """Input-covariance DIAGONAL for an embedding layer: token frequencies.
 
@@ -210,6 +274,41 @@ def compute_g_diag(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
     n = g.shape[0]
     scale = float(n) if batch_averaged else 1.0 / n
     return jnp.sum(g * g, axis=0) * scale
+
+
+def compute_g_dense_sharded(
+    g: jnp.ndarray, shards: int, batch_averaged: bool
+) -> jnp.ndarray:
+    """Stacked per-shard grad-output covariances for a COLUMN-sharded dense
+    kernel: ``[T, m/T, m/T]``.
+
+    A column-sharded matmul's shards produce disjoint output slices, so the
+    shard lens's G factor is exactly block-diagonal — each block the
+    covariance of one output slice (arxiv 2311.00636). One batched einsum
+    (cf. :func:`compute_g_conv_grouped`); scaling matches
+    :func:`compute_g_dense` (``×N`` batch-averaged, ``/N`` otherwise).
+    """
+    g = _flatten_leading(g)
+    n = g.shape[0]
+    gm = g.reshape(n, shards, g.shape[-1] // shards)
+    scale = float(n) if batch_averaged else 1.0 / n
+    return jnp.einsum("nti,ntj->tij", gm, gm * scale, precision=_HIGHEST)
+
+
+def compute_g_moe(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:
+    """Per-expert UNNORMALIZED grad-output covariance sums: ``[E, m, m]``.
+
+    ``g`` is the ``[.., E, m]`` cotangent of the dense per-expert output
+    tensor — already expert-masked by top-1 routing (a token's rows are zero
+    for every expert it did not visit), so the plain contraction IS the
+    per-expert masked sum. Scaled like :func:`compute_g_dense` over the
+    GLOBAL token count; the per-expert normalization (``/ f_e``) happens at
+    EMA time alongside the A side (see :func:`compute_a_moe`).
+    """
+    g = g.reshape(-1, g.shape[-2], g.shape[-1])
+    n = g.shape[0]
+    scale = float(n) if batch_averaged else 1.0 / n
+    return jnp.einsum("nei,nej->eij", g, g * scale, precision=_HIGHEST)
 
 
 def compute_g_conv(g: jnp.ndarray, batch_averaged: bool) -> jnp.ndarray:

@@ -665,15 +665,25 @@ def precondition_all_owner(
             )
         axis = axes[0]
     else:
-        if axis_name not in axes:
+        # a tuple means the joint batch axes of a 3-D data×fsdp×tensor
+        # mesh: the owner index space is their row-major flattening
+        # (axis_index/all_gather/PartitionSpec all agree on that order)
+        names = (
+            (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        )
+        missing = [a for a in names if a not in axes]
+        if missing:
             raise ValueError(
                 f"axis {axis_name!r} not in mesh axes {axes}"
             )
-        axis = axis_name
-    if int(mesh.shape[axis]) != plan.world:
+        axis = names[0] if isinstance(axis_name, str) else tuple(names)
+    axis_world = 1
+    for a in (axis if isinstance(axis, tuple) else (axis,)):
+        axis_world *= int(mesh.shape[a])
+    if axis_world != plan.world:
         raise ValueError(
             f"shard plan world {plan.world} != mesh axis {axis!r} size "
-            f"{int(mesh.shape[axis])}"
+            f"{axis_world}"
         )
     shapes = {n: (g.shape[0], g.shape[1]) for n, g in grad_mats.items()}
     diag_a = {

@@ -192,16 +192,31 @@ class FactorComm:
 
     # -- policy ---------------------------------------------------------
 
+    def _axis_world(self, axis) -> int:
+        """Replica count along the factor axis — a product when ``axis`` is
+        a tuple (3-D data×fsdp×tensor meshes reduce factors over BOTH
+        batch-carrying axes; see training.step.require_pure_dp_mesh)."""
+        if self.mesh is None:
+            return 1
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        world = 1
+        hit = False
+        for a in axes:
+            if a in self.mesh.shape:
+                hit = True
+                world *= int(self.mesh.shape[a])
+        return world if hit else int(self.mesh.devices.size)
+
     @property
     def multi_device(self) -> bool:
-        """More than one replica along the FACTOR axis. On a 2-D data×tensor
-        mesh only the data axis carries K-FAC collectives, so a mesh that is
-        multi-device purely in its tensor axis leaves the plane inert."""
+        """More than one replica along the FACTOR axis (the product of the
+        batch-carrying axes when ``axis_name`` is a tuple). On a 2-D
+        data×tensor mesh only the data axis carries K-FAC collectives, so a
+        mesh that is multi-device purely in its tensor axis leaves the plane
+        inert."""
         if self.mesh is None:
             return False
-        if self.axis_name in self.mesh.shape:
-            return int(self.mesh.shape[self.axis_name]) > 1
-        return self.mesh.devices.size > 1
+        return self._axis_world(self.axis_name) > 1
 
     @property
     def defer(self) -> bool:
@@ -275,12 +290,11 @@ class FactorComm:
                 # independent of issue position, so the values are bitwise
                 # those of the serial order; only the schedule changes.
                 order = list(range(len(bufs)))[::-1]
-                if self.overlap_ppermute:
-                    world = (
-                        int(self.mesh.shape[axis])
-                        if self.mesh is not None and axis in self.mesh.shape
-                        else 1
-                    )
+                # the ppermute ring needs ONE named axis (lax.ppermute does
+                # not linearize tuples); tuple-axis meshes keep the exact
+                # fused psum stream
+                if self.overlap_ppermute and not isinstance(axis, tuple):
+                    world = self._axis_world(axis)
                     merged = [
                         ring_allreduce_mean(bufs[i], axis, world, wire_dtype)
                         for i in order

@@ -62,6 +62,78 @@ def data_tensor_mesh(
     return Mesh(grid, (axis_name, tensor_axis_name))
 
 
+def data_fsdp_tensor_mesh(
+    fsdp: int,
+    tensor_parallel: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = "data",
+    fsdp_axis_name: str = "fsdp",
+    tensor_axis_name: str = "tensor",
+) -> Mesh:
+    """3-D ``data × fsdp × tensor`` mesh — the production LM regime.
+
+    Unlike :func:`data_tensor_mesh` (whose ``tensor*`` axis is reserved for
+    REPLICATED compute), this mesh's axes carry genuine parameter sharding
+    (kfac_pytorch_tpu/shardwise/):
+
+    * ``data``  — plain batch parallelism; the K-FAC factor axis.
+    * ``fsdp*`` — batch-carrying AND parameter-sharding: params store their
+      leading dim split over it and allgather for compute, so each device
+      still sees whole examples — the mesh validators
+      (``training.step.require_pure_dp_mesh``) treat ``fsdp*`` axes as part
+      of the batch plane, and owner factor shards size to
+      ``data_world × fsdp_world`` (KFAC._data_world).
+    * ``tensor*`` — COMPUTE-sharded tensor parallelism: shard-lens layers
+      (``KFACShardedDense``) split kernels over it and keep the matching
+      per-shard factor blocks local (shardwise.factor_leaf_spec). The only
+      tensor-axis collectives in a capture step are the forward/backward
+      psums the matmul sharding itself requires — the factor plane adds
+      zero (pinned by ``scripts/check_collective_count.py``).
+
+    Device order is row-major ``(data, fsdp, tensor)``: tensor-shard peers
+    are mesh neighbors (ICI-adjacent on TPU slices), fsdp peers next.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if fsdp < 1 or tensor_parallel < 1:
+        raise ValueError(
+            f"fsdp={fsdp} and tensor_parallel={tensor_parallel} must be >= 1"
+        )
+    if devices.size % (fsdp * tensor_parallel):
+        raise ValueError(
+            f"fsdp×tensor_parallel={fsdp}×{tensor_parallel} does not divide "
+            f"{devices.size} devices"
+        )
+    if not fsdp_axis_name.startswith("fsdp"):
+        raise ValueError(
+            "the fsdp axis must be named 'fsdp*' — the mesh validators key "
+            f"on the prefix; got {fsdp_axis_name!r}"
+        )
+    if not tensor_axis_name.startswith("tensor"):
+        raise ValueError(
+            "the tensor axis must be named 'tensor*' — the mesh validators "
+            f"key on the prefix; got {tensor_axis_name!r}"
+        )
+    grid = devices.reshape(
+        devices.size // (fsdp * tensor_parallel), fsdp, tensor_parallel
+    )
+    return Mesh(grid, (axis_name, fsdp_axis_name, tensor_axis_name))
+
+
+def batch_axes(mesh: Mesh, axis_name: str = "data"):
+    """The batch-carrying axes of a mesh: ``axis_name`` plus every ``fsdp*``
+    axis (size > 1). Returns a tuple usable both as a PartitionSpec dim
+    entry and as a collective axis-name argument."""
+    axes = []
+    if axis_name in mesh.shape:
+        axes.append(axis_name)
+    for a in mesh.axis_names:
+        if str(a).startswith("fsdp") and int(mesh.shape[a]) > 1:
+            axes.append(str(a))
+    return tuple(axes) if axes else (mesh.axis_names[0],)
+
+
 def split_service_mesh(
     service_devices: int,
     devices: Optional[Sequence[jax.Device]] = None,

@@ -103,10 +103,25 @@ class ModelFacts:
     shapes: Dict[str, Tuple[int, int]]
     diag_a: FrozenSet[str] = frozenset()
     has_conv: bool = False
+    # Sharded-parameter layers (kfac_pytorch_tpu/shardwise/): layer name →
+    # (form, block count) for "#c"/"#r"/"#e" entries. Their ``shapes``
+    # entry holds the PER-BLOCK (g, a) sides; the cost functions below
+    # multiply out the stack. Empty for pre-shardwise models.
+    shard_counts: Dict[str, Tuple[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def has_diag_a(self) -> bool:
         return bool(self.diag_a)
+
+    @property
+    def has_shard_lens(self) -> bool:
+        return any(f in ("c", "r") for f, _ in self.shard_counts.values())
+
+    @property
+    def has_moe(self) -> bool:
+        return any(f == "e" for f, _ in self.shard_counts.values())
 
 
 def model_facts(params, layers=None) -> ModelFacts:
@@ -124,7 +139,29 @@ def model_facts(params, layers=None) -> ModelFacts:
     shapes: Dict[str, Tuple[int, int]] = {}
     diag_a = set()
     has_conv = False
+    shard_counts: Dict[str, Tuple[str, int]] = {}
     for name in names:
+        sbase, form, count = capture.split_shard_name(name)
+        if form is not None:
+            node = params
+            for k in sbase.split("/"):
+                node = node[k]
+            kernel = node["kernel"]
+            has_bias = "bias" in node
+            if form == "e":
+                # MoE expert bank: [E, a, m] kernel, per-expert (m, a)
+                _, a_in, m_out = kernel.shape
+                shapes[name] = (int(m_out), int(a_in))
+            elif form == "c":
+                # column: shared A side, per-shard G side m/T
+                cin, cout = kernel.shape
+                shapes[name] = (int(cout) // count, int(cin + int(has_bias)))
+            else:
+                # row: per-shard A side a/T (bias-free), shared G side
+                cin, cout = kernel.shape
+                shapes[name] = (int(cout), int(cin) // count)
+            shard_counts[name] = (form, count)
+            continue
         base, group_idx = capture.split_group_name(name)
         base, split_idx = capture.split_lens_name(base)
         node = params
@@ -153,7 +190,8 @@ def model_facts(params, layers=None) -> ModelFacts:
                 cout = cout // scounts[base]
             shapes[name] = (int(cout), int(cin + int(has_bias)))
     return ModelFacts(
-        shapes=shapes, diag_a=frozenset(diag_a), has_conv=has_conv
+        shapes=shapes, diag_a=frozenset(diag_a), has_conv=has_conv,
+        shard_counts=shard_counts,
     )
 
 
@@ -173,13 +211,26 @@ def _rank_fn_for(plan: Plan):
 
 def _dense_sides(facts: ModelFacts):
     """Every dense factor side the refresh decomposes: diag-A layers
-    contribute only their G side (the A refresh is elementwise)."""
+    contribute only their G side (the A refresh is elementwise); shard
+    entries contribute one per-block side per stacked block (column:
+    shared A + T G blocks; row: T A blocks + shared G; MoE: E of each)."""
     sides = []
     for name in sorted(facts.shapes):
         g, a = facts.shapes[name]
-        if name not in facts.diag_a:
+        form, count = facts.shard_counts.get(name, (None, 1))
+        if form == "c":
             sides.append(a)
-        sides.append(g)
+            sides.extend([g] * count)
+        elif form == "r":
+            sides.extend([a] * count)
+            sides.append(g)
+        elif form == "e":
+            sides.extend([a] * count)
+            sides.extend([g] * count)
+        else:
+            if name not in facts.diag_a:
+                sides.append(a)
+            sides.append(g)
     return sides
 
 
@@ -197,7 +248,14 @@ def precondition_cost(facts: ModelFacts) -> int:
     ``g²a + ga²`` (``g²a`` diag-A) count the LPT assignment balances."""
     total = 0
     for name, (g, a) in facts.shapes.items():
-        total += g * g * a if name in facts.diag_a else g * g * a + g * a * a
+        form_count = facts.shard_counts.get(name)
+        if form_count is not None:
+            # per-block rotation cost × block count, on the per-block sides
+            total += form_count[1] * (g * g * a + g * a * a)
+        elif name in facts.diag_a:
+            total += g * g * a
+        else:
+            total += g * g * a + g * a * a
     return total
 
 
@@ -212,11 +270,24 @@ def wire_bytes_f32(facts: ModelFacts) -> Tuple[int, int]:
     leaf_shapes = []
     for name in sorted(facts.shapes):
         g, a = facts.shapes[name]
-        if name in facts.diag_a:
+        form, count = facts.shard_counts.get(name, (None, 1))
+        if form == "c":
+            # replicated A + stacked per-shard G (the G stack is device-
+            # sharded; a replica's wire slice is what the bucket sums)
+            leaf_shapes.append((a, a))
+            leaf_shapes.append((count, g, g))
+        elif form == "r":
+            leaf_shapes.append((count, a, a))
+            leaf_shapes.append((g, g))
+        elif form == "e":
+            leaf_shapes.append((count, a, a))
+            leaf_shapes.append((count, g, g))
+        elif name in facts.diag_a:
             leaf_shapes.append((a,))
+            leaf_shapes.append((g, g))
         else:
             leaf_shapes.append((a, a))
-        leaf_shapes.append((g, g))
+            leaf_shapes.append((g, g))
     buckets = plan_factor_buckets(leaf_shapes)
     return sum(b.size for b in buckets) * 4, len(buckets)
 

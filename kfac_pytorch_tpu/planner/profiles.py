@@ -249,6 +249,12 @@ class PlanEnv:
     track_diagnostics: bool = False
     has_diag_a_layers: bool = False
     has_conv_layers: bool = True
+    # Sharded-parameter model facts (kfac_pytorch_tpu/shardwise/): any
+    # column/row/FSDP shard-lens layer ("#c/#r" names), any MoE expert bank
+    # ("#e" names). Both default False so pre-shardwise envs decode
+    # unchanged.
+    has_shard_lens_layers: bool = False
+    has_moe_layers: bool = False
     on_tpu: bool = False
     fac_update_freq: int = 10
     kfac_update_freq: int = 100
@@ -269,13 +275,15 @@ class PlanEnv:
 
     @property
     def pure_dp(self) -> bool:
-        """At most one non-tensor mesh axis — what the explicit-collective
-        comm wrappers require (training/step.py::require_pure_dp_mesh).
-        Axes named ``tensor*`` carry replicated compute in the 2-D
-        data×tensor convention (parallel/mesh.py::data_tensor_mesh), so the
-        K-FAC collectives still ride a single data axis through them."""
+        """At most one mesh axis outside the batch/tensor conventions —
+        what the explicit-collective comm wrappers require
+        (training/step.py::require_pure_dp_mesh). Axes named ``tensor*``
+        carry replicated or shard-lens compute (parallel/mesh.py), and
+        ``fsdp*`` axes carry whole examples (parameter sharding only), so
+        the K-FAC collectives ride the batch-axes tuple through both."""
         data_axes = [
-            a for a in self.mesh_axes if not str(a).startswith("tensor")
+            a for a in self.mesh_axes
+            if not str(a).startswith("tensor") and not str(a).startswith("fsdp")
         ]
         return len(data_axes) <= 1
 
@@ -522,6 +530,102 @@ RULES: Tuple[Rule, ...] = (
                 "snapshots and installs full replicated bases; "
                 "factor_sharding='owner' keeps per-owner shards that would "
                 "have to gather through the mailbox every boundary",
+    ),
+    # Shard-lens / MoE exclusions (kfac_pytorch_tpu/shardwise/). The model
+    # facts are ENV, not levers, so two of these rows guard env-vs-env
+    # compositions (inverse, diag_blocks): they apply to every plan and
+    # drop nothing — fit_plan cannot repair a model/method mismatch, only
+    # check_plan/the constructor can refuse it. The lever-engaging rows
+    # shed their lever as usual. BEFORE staleness_requires_slack (which
+    # must stay last): shedding deferral/service here orphans a budget.
+    Rule(
+        name="shard_lens_vs_inverse",
+        applies=lambda p: True,
+        conflicts=lambda p, e: (
+            (e.has_shard_lens_layers or e.has_moe_layers)
+            and e.precond_method == "inverse"
+        ),
+        drop=(),
+        enforced_by="constructor",
+        message="shard-lens/MoE layers precondition through per-shard "
+                "eigenbases (shardwise.precondition); precond_method="
+                "'inverse' keeps whole-factor Cholesky inverses that have "
+                "no per-shard block layout",
+    ),
+    Rule(
+        name="shard_lens_vs_diag_blocks",
+        applies=lambda p: True,
+        conflicts=lambda p, e: (
+            (e.has_shard_lens_layers or e.has_moe_layers)
+            and e.diag_blocks > 1
+        ),
+        drop=(),
+        enforced_by="constructor",
+        message="shard-lens/MoE factors already carry a stack (block) "
+                "dimension per shard; diag_blocks > 1 would carve a second "
+                "block structure into the same factors",
+    ),
+    Rule(
+        name="shard_lens_vs_owner_sharding",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.has_shard_lens_layers,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="shard-lens factors are already device-sharded along the "
+                "tensor axis (shardwise.factor_leaf_spec); factor_sharding="
+                "'owner' would re-shard them over the batch axes and force "
+                "a gather on every solve",
+    ),
+    Rule(
+        name="moe_vs_owner_sharding",
+        applies=lambda p: p.factor_sharding == "owner",
+        conflicts=lambda p, e: e.has_moe_layers,
+        drop=("factor_sharding",),
+        enforced_by="constructor",
+        message="MoE expert banks keep per-expert [E, n, n] factor stacks "
+                "whose token-count-weighted EMA runs where the dispatch "
+                "statistics live; factor_sharding='owner' has no slot "
+                "layout for expert stacks",
+    ),
+    Rule(
+        name="shard_lens_vs_chunks",
+        applies=lambda p: p.eigh_chunks > 1,
+        conflicts=lambda p, e: e.has_shard_lens_layers or e.has_moe_layers,
+        drop=("eigh_chunks",),
+        enforced_by="constructor",
+        message="eigh_chunks > 1 pipelines the refresh through the "
+                "whole-factor slot planner; shard-lens/MoE stacks refresh "
+                "as batched per-block eigh outside that plan",
+    ),
+    Rule(
+        name="shard_lens_vs_streaming",
+        applies=lambda p: p.solver == "streaming",
+        conflicts=lambda p, e: e.has_shard_lens_layers or e.has_moe_layers,
+        drop=("solver",),
+        enforced_by="constructor",
+        message="solver='streaming' folds factors through retained "
+                "whole-factor bases; shard-lens/MoE stacks have no "
+                "streaming fold",
+    ),
+    Rule(
+        name="moe_vs_deferred_comm",
+        applies=lambda p: p.factor_comm_freq > 1,
+        conflicts=lambda p, e: e.has_moe_layers,
+        drop=("factor_comm_freq",),
+        enforced_by="constructor",
+        message="factor_comm_freq > 1 merges deferred factor EMAs by "
+                "linearity; the MoE token-count-weighted per-expert decay "
+                "(alpha**(f_e*E)) is not linear in the deferred statistics",
+    ),
+    Rule(
+        name="service_vs_shard_lens",
+        applies=lambda p: p.service_devices > 0,
+        conflicts=lambda p, e: e.has_shard_lens_layers or e.has_moe_layers,
+        drop=("service_devices",),
+        enforced_by="constructor",
+        message="service_devices > 0 publishes replicated whole-factor "
+                "snapshots to refresh workers; shard-lens/MoE factor "
+                "stacks live device-sharded and never leave the mesh",
     ),
     # Last on purpose: its conflict is plan-internal, so it must see the
     # plan AFTER every rule above has cleared levers — a fitted plan that

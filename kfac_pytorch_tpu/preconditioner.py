@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from kfac_pytorch_tpu import capture
+from kfac_pytorch_tpu import capture, shardwise
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
 from kfac_pytorch_tpu.ops import factor_kernels as factor_kernel_ops
 from kfac_pytorch_tpu.ops import factors as factor_ops
@@ -107,6 +107,23 @@ class KFACHParams:
 def _validate(name: str, ok: bool, value) -> None:
     if not ok:
         raise ValueError(f"Invalid {name}: {value}")
+
+
+def _non_tensor_world(mesh: Optional[Mesh], axis_name: str) -> int:
+    """Replica count along the FACTOR plane: the product of every
+    non-``tensor*`` mesh-axis size (``data`` × any ``fsdp*`` axes — both
+    carry whole examples, so both carry factor contributions; see
+    parallel/mesh.py::data_fsdp_tensor_mesh). ``tensor*`` replicas hold
+    identical factor rows and are excluded."""
+    if mesh is None:
+        return 1
+    if axis_name not in mesh.shape:
+        return int(mesh.devices.size)
+    world = 1
+    for a in mesh.axis_names:
+        if not str(a).startswith("tensor"):
+            world *= int(mesh.shape[a])
+    return world
 
 
 class KFAC:
@@ -227,6 +244,14 @@ class KFAC:
         # params heuristic; REQUIRED for models mixing in non-K-FAC
         # kernel-bearing modules (grouped convs, plain nn.Dense).
         self.layers = list(layers) if layers is not None else None
+        # Shard-lens layer registry (kfac_pytorch_tpu/shardwise/): the
+        # ``#c``/``#r``/``#e`` names capture.discover_layers emits for
+        # tensor-sharded and MoE kernels. Only an explicit layers= list can
+        # carry them (the params heuristic never synthesizes shard names),
+        # so the named refusals below fire at construction, not mid-step.
+        self.shard_layers = shardwise.shard_entries(self.layers or [])
+        self.has_shard_lens = shardwise.has_shard_lens(self.layers or [])
+        self.has_moe = shardwise.has_moe(self.layers or [])
         # Precision of the every-step eigenbasis rotations (see
         # ops/precondition.py::_ROTATION_PRECISION for the default and why).
         # Accepts a lax.Precision or the strings 'default'/'high'/'highest'.
@@ -311,6 +336,8 @@ class KFAC:
                         ]
                     )
                 ),
+                has_shard_lens_layers=self.has_shard_lens,
+                has_moe_layers=self.has_moe,
                 mesh_axes=()
                 if mesh is None
                 else tuple(str(a) for a in mesh.axis_names),
@@ -479,6 +506,10 @@ class KFAC:
             factor_sharding in ("replicated", "owner"),
             factor_sharding,
         )
+        # pre-degrade value: the shard-lens validity refusals below fire on
+        # what the caller ASKED for, even where a 1-device mesh would have
+        # degraded owner mode to replicated anyway
+        self.requested_factor_sharding = factor_sharding
         if factor_sharding == "owner":
             if precond_method != "eigen":
                 raise ValueError(
@@ -508,28 +539,30 @@ class KFAC:
                     "track_diagnostics with replicated sharding"
                 )
             if mesh is not None and mesh.devices.size > 1:
-                # The shard stacks ride the factor axis only; extra axes are
-                # fine iff they are replicated-compute tensor axes (the
-                # data_tensor_mesh convention) — anything else would split
-                # examples or factor rows in ways the plan cannot see.
+                # The shard stacks ride the factor plane only; extra axes
+                # are fine iff they are replicated-compute tensor axes or
+                # batch-carrying fsdp axes (the data_fsdp_tensor_mesh
+                # convention — fsdp replicas see whole examples and JOIN the
+                # factor plane, so owner shards size to data×fsdp) —
+                # anything else would split examples or factor rows in ways
+                # the plan cannot see.
                 bad = [
                     a
                     for a in mesh.axis_names
                     if a != axis_name
                     and int(mesh.shape[a]) > 1
-                    and not str(a).startswith("tensor")
+                    and not (
+                        str(a).startswith("tensor")
+                        or str(a).startswith("fsdp")
+                    )
                 ]
                 if axis_name not in mesh.axis_names or bad:
                     raise ValueError(
                         "factor_sharding='owner' requires a data-plane mesh "
-                        f"(axis {axis_name!r} plus optional 'tensor*' axes); "
-                        f"got axes {tuple(mesh.axis_names)}"
+                        f"(axis {axis_name!r} plus optional 'tensor*'/"
+                        f"'fsdp*' axes); got axes {tuple(mesh.axis_names)}"
                     )
-            _data_size = (
-                int(mesh.shape[axis_name])
-                if mesh is not None and axis_name in mesh.shape
-                else (mesh.devices.size if mesh is not None else 1)
-            )
+            _data_size = _non_tensor_world(mesh, axis_name)
             if mesh is None or _data_size <= 1:
                 # Mirrors the distribute_precondition warning: trainers pass
                 # the same flags to 1-device dev runs. There is nothing to
@@ -664,9 +697,24 @@ class KFAC:
             )
             comm_overlap = False
         self.comm_overlap = bool(comm_overlap)
+        # Batch-carrying reduction axes of the factor plane: the data axis
+        # plus any size>1 fsdp* axes (parallel/mesh.py::data_fsdp_tensor_mesh
+        # — fsdp replicas see whole examples, so their statistics reduce
+        # alongside; PartitionSpec entries and lax collectives accept the
+        # tuple transparently). A plain string on every pre-3-D mesh, so
+        # existing programs are untouched.
+        self.batch_axes: Any = axis_name
+        if mesh is not None:
+            _fsdp_axes = tuple(
+                str(a)
+                for a in mesh.axis_names
+                if str(a).startswith("fsdp") and int(mesh.shape[a]) > 1
+            )
+            if _fsdp_axes:
+                self.batch_axes = (axis_name,) + _fsdp_axes
         self.factor_comm = FactorComm(
             mesh=mesh,
-            axis_name=axis_name,
+            axis_name=self.batch_axes,
             comm_dtype=factor_comm_dtype,
             comm_freq=factor_comm_freq,
             sharded=self.owner_sharded,
@@ -716,6 +764,78 @@ class KFAC:
         # the cadence never slips, keeping replays (expected_step_variants)
         # and tests deterministic by default.
         self.staleness_signal = None
+        # Shard-lens validity (named after the planner rules of the same
+        # names, planner/profiles.py). Shardwise factor stacks always
+        # refresh DENSELY per block (the blocks are 1/T- or per-expert-
+        # sized; there is no whole-factor eigh spike left), so every lever
+        # that reshapes the refresh — inverses, chunk pipelining, streaming
+        # folds, diagonal blocking, owner re-homing, the curvature service —
+        # has nothing coherent to act on and refuses up front rather than
+        # silently skipping the shard layers.
+        if self.has_shard_lens or self.has_moe:
+            kind = "MoE expert banks" if not self.has_shard_lens else (
+                "shard-lens layers"
+            )
+            if self.precond_method == "inverse":
+                raise ValueError(
+                    f"{kind} precondition per shard block in the eigenbasis "
+                    "(shardwise.precondition); precond_method='inverse' "
+                    "keeps whole-factor Cholesky inverses with no per-block "
+                    "layout — use the eigen method (planner rule "
+                    "shard_lens_vs_inverse)"
+                )
+            if self.requested_factor_sharding == "owner":
+                raise ValueError(
+                    f"{kind} pin each factor block to the device holding "
+                    "the matching kernel shard (shardwise.factor_leaf_spec); "
+                    "factor_sharding='owner' would re-home those blocks "
+                    "onto LPT owners and gather them back every step — "
+                    "pick one placement scheme (planner rule "
+                    + (
+                        "moe_vs_owner_sharding)"
+                        if self.has_moe and not self.has_shard_lens
+                        else "shard_lens_vs_owner_sharding)"
+                    )
+                )
+            if self.eigh_chunks > 1:
+                raise ValueError(
+                    f"{kind} refresh densely per block — there is no "
+                    "whole-factor eigh spike for eigh_chunks > 1 to spread, "
+                    "and the chunk planner's slot tables do not describe "
+                    "stacked factors (planner rule shard_lens_vs_chunks)"
+                )
+            if self.solver == "streaming":
+                raise ValueError(
+                    f"{kind} keep dense per-block bases; solver='streaming' "
+                    "folds factors through retained truncated bases that "
+                    "the stacked layout does not carry — non-shard layers "
+                    "may ride solver='rsvd' instead (planner rule "
+                    "shard_lens_vs_streaming)"
+                )
+            if self.diag_blocks != 1:
+                raise ValueError(
+                    f"{kind} already block their factors along shard/expert "
+                    "boundaries; diag_blocks > 1 would carve a second, "
+                    "conflicting block structure into the same factors "
+                    "(planner rule shard_lens_vs_diag_blocks)"
+                )
+            if self.service_devices > 0:
+                raise ValueError(
+                    f"{kind} refresh in-step (cheap dense per-block eigh); "
+                    "service_devices > 0 publishes whole-factor snapshots "
+                    "the worker protocol does not lay out as stacks — run "
+                    "the service on unsharded models (planner rule "
+                    "service_vs_shard_lens)"
+                )
+        if self.has_moe and self.factor_comm.comm_freq > 1:
+            raise ValueError(
+                "MoE expert banks use the token-count-weighted EMA "
+                "(shardwise.moe_ema), whose per-expert decay alpha**w_e is "
+                "not linear in the contributions — deferred factor "
+                "communication (factor_comm_freq > 1) merges per-replica "
+                "EMAs by linearity and would silently corrupt expert "
+                "statistics (planner rule moe_vs_deferred_comm)"
+            )
         self.hparams = KFACHParams(
             damping=damping,
             kl_clip=kl_clip,
@@ -808,15 +928,13 @@ class KFAC:
         return int(self.mesh.devices.size)
 
     def _data_world(self) -> int:
-        """Replica count along the FACTOR axis — what the owner shard plans
+        """Replica count along the FACTOR plane — what the owner shard plans
         size to. On a 2-D data×tensor mesh the shard stacks split over the
-        data axis only (tensor replicas hold identical rows), unlike
+        data axis only (tensor replicas hold identical rows); on a 3-D
+        data×fsdp×tensor mesh they split over data×fsdp (fsdp replicas see
+        whole examples and carry their own factor rows) — unlike
         :meth:`_world`'s all-device eigh work-sharding."""
-        if self.mesh is None:
-            return 1
-        if self.axis_name in self.mesh.shape:
-            return int(self.mesh.shape[self.axis_name])
-        return int(self.mesh.devices.size)
+        return _non_tensor_world(self.mesh, self.axis_name)
 
     # ------------------------------------------------------------------
     # Owner sharding (factor_sharding="owner")
@@ -882,10 +1000,34 @@ class KFAC:
                 "NamedShardings against"
             )
         sharded_keys = ("factor_shard", "eigen_shard", "eigen_pending_shard")
-        split = NamedSharding(self.mesh, P(self.axis_name))
+        split = NamedSharding(self.mesh, P(self.batch_axes))
         full = NamedSharding(self.mesh, P())
+        shard_entries = shardwise.shard_entries(list(state["factors"].keys()))
         out = {}
         for key, sub in state.items():
+            if key in ("factors", "eigen") and shard_entries:
+                # Shardwise layers place each factor/eigen block on the
+                # device holding the matching kernel shard (column G-side
+                # and row A-side stacks split over the tensor axis —
+                # shardwise.factor_leaf_spec); everything else replicates.
+                mapped = {}
+                for name, entry in sub.items():
+                    if name in shard_entries:
+                        mapped[name] = {
+                            k: NamedSharding(
+                                self.mesh,
+                                shardwise.factor_leaf_spec(
+                                    name, k, tuple(v.shape), self.mesh
+                                ),
+                            )
+                            for k, v in entry.items()
+                        }
+                    else:
+                        mapped[name] = jax.tree_util.tree_map(
+                            lambda _leaf: full, entry
+                        )
+                out[key] = mapped
+                continue
             put = split if key in sharded_keys else full
             out[key] = jax.tree_util.tree_map(lambda _leaf, s=put: s, sub)
         return out
@@ -1144,6 +1286,17 @@ class KFAC:
         scounts = capture.lens_counts(names)
         facs = {}
         for name in names:
+            sbase, form, count = capture.split_shard_name(name)
+            if form is not None:
+                # shard-lens layer (#c/#r/#e): identity stacks shaped by the
+                # sharding form (kfac_pytorch_tpu/shardwise/)
+                node = params
+                for k in sbase.split("/"):
+                    node = node[k]
+                facs[name] = shardwise.identity_factors(
+                    form, count, tuple(node["kernel"].shape), "bias" in node
+                )
+                continue
             base, group_idx = capture.split_group_name(name)
             base, split_idx = capture.split_lens_name(base)
             node = params
@@ -1203,6 +1356,14 @@ class KFAC:
         facs = self._identity_factors(params)
         eigen = {}
         for name, f in facs.items():
+            _, form, _ = capture.split_shard_name(name)
+            if form is not None:
+                # shard-lens eigen entries carry FORM-PREFIXED keys
+                # (cQA/rdG/…) so the singles/stacked split and the diag-A
+                # detection leave them alone; always f32 (the stacks never
+                # ride the eigen_dtype downcast — see shardwise/lenses.py)
+                eigen[name] = shardwise.identity_eigen(form, f)
+                continue
             if "A_diag" in f:
                 vocab = int(f["A_diag"].shape[0])
                 feats = int(f["G"].shape[0])
@@ -1506,6 +1667,10 @@ class KFAC:
         # The layer set was fixed at init() — state IS the source of truth,
         # so a heuristic/params mismatch cannot silently widen the set here.
         names = list(state["factors"].keys())
+        # shard-lens layers (#c/#r/#e) branch out of the generic EMA /
+        # refresh / precondition flows below (kfac_pytorch_tpu/shardwise/)
+        shard_items = shardwise.shard_entries(names)
+        norm_names = [n for n in names if n not in shard_items]
         is_conv = {}
         for name in names:
             node = grads
@@ -1536,22 +1701,39 @@ class KFAC:
                     "discover_layers(model, ...)) so init() matches capture."
                 )
             # EMA runs elementwise, so the same update serves dense A
-            # matrices and embedding A_diag vectors (identity init = ones).
+            # matrices, embedding A_diag vectors (identity init = ones), and
+            # the column/row shard stacks (update_running_avg broadcasts
+            # over the stack dim). Only MoE diverges: its token-count-
+            # weighted per-expert decay routes through shardwise.ema_update.
             with tel.span("trace/kfac/factor_update"):
-                facs = {
-                    name: {
-                        ("A_diag" if "A_diag" in facs[name] else "A"):
+                old_facs = facs
+                facs = {}
+                for name in names:
+                    se = shard_items.get(name)
+                    if se is not None:
+                        facs[name] = shardwise.ema_update(
+                            se[1],
+                            old_facs[name],
+                            a_contribs[name],
+                            g_factor_stats[name],
+                            self.factor_decay,
+                        )
+                        continue
+                    facs[name] = {
+                        ("A_diag" if "A_diag" in old_facs[name] else "A"):
                             factor_ops.update_running_avg(
                                 a_contribs[name],
-                                facs[name].get("A", facs[name].get("A_diag")),
+                                old_facs[name].get(
+                                    "A", old_facs[name].get("A_diag")
+                                ),
                                 self.factor_decay,
                             ),
                         "G": factor_ops.update_running_avg(
-                            g_factor_stats[name], facs[name]["G"], self.factor_decay
+                            g_factor_stats[name],
+                            old_facs[name]["G"],
+                            self.factor_decay,
                         ),
                     }
-                    for name in names
-                }
         if flush_factors:
             # Deferred-mode merge of the per-replica running averages —
             # AFTER this step's EMA (so the flush includes it), BEFORE any
@@ -1612,45 +1794,65 @@ class KFAC:
             # (kfac_preconditioner.py:361-367), via the static flag.
             diag_blocks = self.diag_blocks if diag_warmup_done else 1
             world = self._world()
+            norm_facs = {n: facs[n] for n in norm_names}
             with tel.span("trace/kfac/eigh"):
-                if world > 1:
+                if not norm_facs:
+                    eigen = {}
+                elif world > 1:
                     table = layer_assignment(
-                        names,
+                        norm_names,
                         is_conv,
                         world,
                         self.distribute_layer_factors,
                         diag_blocks,
                     )
                     eigen = sharded_eigen_update(
-                        facs, table, self.mesh, self.axis_name, self.eps,
+                        norm_facs, table, self.mesh, self.axis_name, self.eps,
                         rank_fn=self._rank_fn(),
                     )
                 else:
                     blocks = {
-                        name: (diag_blocks if is_conv[name] else 1) for name in names
+                        name: (diag_blocks if is_conv[name] else 1)
+                        for name in norm_names
                     }
                     eigen = replicated_eigen_update(
-                        facs, blocks, self.eps, rank_fn=self._rank_fn()
+                        norm_facs, blocks, self.eps, rank_fn=self._rank_fn()
                     )
+                # Shard-lens layers: per-block dense eigh, batched over the
+                # stack dim, replicated on every device holding the block
+                # (shardwise/lenses.py) — no assignment table, no collective.
+                for n, (_, form, _) in shard_items.items():
+                    eigen[n] = shardwise.eigen_refresh(form, facs[n])
                 # Diagonal-A (embedding) layers: the A "eigendecomposition" is
                 # the diagonal itself (eigenvectors = identity) — no eigh, just
                 # the reference's eigenvalue floor (kfac_preconditioner.py:253).
-                for n in names:
+                for n in norm_names:
                     if "A_diag" in facs[n]:
                         d = facs[n]["A_diag"]
                         eigen[n]["dA"] = d * (d > self.eps)
                 if self.solver in ("rsvd", "streaming"):
-                    spectrum_mass = self._spectrum_mass(facs, eigen, names)
+                    spectrum_mass = self._spectrum_mass(
+                        facs, eigen, norm_names
+                    )
                 if self.track_diagnostics:
                     # grab the f32 per-layer spectra while the eigen dict is
-                    # still in full per-layer form (stacks lose layer keys)
-                    fresh_spectra = {
-                        n: (
-                            _side_spectrum(eigen[n], "A"),
-                            _side_spectrum(eigen[n], "G"),
-                        )
-                        for n in names
-                    }
+                    # still in full per-layer form (stacks lose layer keys);
+                    # shard entries contribute their flattened per-block
+                    # spectra so the diagnostics pytree keeps every layer
+                    fresh_spectra = {}
+                    for n in names:
+                        se = shard_items.get(n)
+                        if se is not None:
+                            _, da_k, _, dg_k = shardwise.EIGEN_KEYS[se[1]]
+                            fresh_spectra[n] = (
+                                eigen[n][da_k].reshape(-1),
+                                eigen[n][dg_k].reshape(-1),
+                            )
+                        else:
+                            fresh_spectra[n] = (
+                                _side_spectrum(eigen[n], "A"),
+                                _side_spectrum(eigen[n], "G"),
+                            )
                 if self.eigen_dtype != jnp.float32:
                     # eigh itself always runs f32; only the stored/streamed Q
                     # matrices downcast (eigenvalues stay f32 for the divide)
@@ -1839,13 +2041,21 @@ class KFAC:
             name: mat.astype(jnp.float32)
             for name, mat in capture.grad_mats(lgrads).items()
         }
+        # Shard-lens gmats (stacked 3-D, or block-structured 2-D) solve
+        # shard-locally (shardwise.precondition) — they never enter the
+        # generic same-shape batching / distributed-assignment paths, whose
+        # shape grouping assumes plain [a, m] mats.
+        shard_items = shardwise.shard_entries(names)
+        norm_gmats = {n: g for n, g in gmats.items() if n not in shard_items}
         precision_args = (
             (self.precond_precision,) if self.precond_precision is not None else ()
         )
         inverse = self.precond_method == "inverse"
-        if self.distribute_precondition and self._world() > 1:
+        if not norm_gmats:
+            updates = {}
+        elif self.distribute_precondition and self._world() > 1:
             owners = precondition_assignment(
-                {name: tuple(g.shape) for name, g in gmats.items()},
+                {name: tuple(g.shape) for name, g in norm_gmats.items()},
                 self._world(),
                 diag_a={n for n, f in facs.items() if "A_diag" in f},
             )
@@ -1855,17 +2065,21 @@ class KFAC:
                 else precond_ops.precondition_all_distributed
             )
             updates = dist_fn(
-                gmats, eigen, damping, *precision_args, stacked=stacked,
+                norm_gmats, eigen, damping, *precision_args, stacked=stacked,
                 mesh=self.mesh, owners=owners,
                 comm_dtype=self.precond_comm_dtype,
             )
         elif inverse:
             updates = precond_ops.precondition_all_inv(
-                gmats, eigen, *precision_args, stacked=stacked
+                norm_gmats, eigen, *precision_args, stacked=stacked
             )
         else:
             updates = precond_ops.precondition_all(
-                gmats, eigen, damping, *precision_args, stacked=stacked
+                norm_gmats, eigen, damping, *precision_args, stacked=stacked
+            )
+        for n, (_, form, count) in shard_items.items():
+            updates[n] = shardwise.precondition(
+                form, count, gmats[n], eigen[n], damping
             )
 
         # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
@@ -2008,7 +2222,7 @@ class KFAC:
                         shard,
                         plan,
                         self.mesh,
-                        self.axis_name,
+                        self.batch_axes,
                         self.eps,
                         rank_fn=self._rank_fn(),
                         eigen_dtype=self.eigen_dtype,
@@ -2021,7 +2235,7 @@ class KFAC:
                         eigen_shard,
                         plan,
                         self.mesh,
-                        self.axis_name,
+                        self.batch_axes,
                         rank_fn=self._rank_fn(),
                     )
         elif eigen_chunk is not None:
@@ -2038,7 +2252,7 @@ class KFAC:
                     jobs,
                     plan,
                     self.mesh,
-                    self.axis_name,
+                    self.batch_axes,
                     self.eps,
                     rank_fn=self._rank_fn(),
                     eigen_dtype=self.eigen_dtype,
@@ -2053,7 +2267,7 @@ class KFAC:
                         eigen_shard,
                         plan,
                         self.mesh,
-                        self.axis_name,
+                        self.batch_axes,
                         rank_fn=self._rank_fn(),
                     )
         elif swap_eigen:
@@ -2068,7 +2282,7 @@ class KFAC:
                     eigen_shard,
                     plan,
                     self.mesh,
-                    self.axis_name,
+                    self.batch_axes,
                     rank_fn=self._rank_fn(),
                 )
 
@@ -2094,7 +2308,7 @@ class KFAC:
                         eigen_shard,
                         plan,
                         self.mesh,
-                        self.axis_name,
+                        self.batch_axes,
                         self.eps,
                         rank_fn=self._rank_fn(),
                     )
@@ -2122,7 +2336,16 @@ class KFAC:
             new_state["stream_residual"] = stream_residual
             new_state["stream_fold_steps"] = stream_fold_steps
         if local is not None:
-            new_state["factor_local"] = local
+            # Pin the per-replica accumulators to the replicated spec: their
+            # shards deliberately diverge (each device holds its own batch
+            # shard's statistics), so a GSPMD layout choice that splits a
+            # leaf whose dim happens to equal the batch world would silently
+            # interleave rows from different replicas' accumulators — and
+            # snapshot packing reads whole per-device copies.
+            _rep = NamedSharding(self.mesh, P())
+            new_state["factor_local"] = jax.tree_util.tree_map(
+                lambda v: jax.lax.with_sharding_constraint(v, _rep), local
+            )
             new_state["factor_sync_age"] = (
                 jnp.zeros((), jnp.int32)
                 if flush_factors
@@ -2159,7 +2382,7 @@ class KFAC:
             plan=plan,
             rank_fn=self._rank_fn(),
             eigen_dtype=self.eigen_dtype,
-            axis_name=self.axis_name,
+            axis_name=self.batch_axes,
         )
         nu = precond_ops.kl_clip_coefficient(
             updates, gmats, lr, self.hparams.kl_clip

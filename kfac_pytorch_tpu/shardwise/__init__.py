@@ -1,0 +1,23 @@
+"""Sharded-parameter K-FAC: per-shard factor capture and preconditioning
+for tensor-parallel, FSDP, and MoE kernels over a 3-D mesh.
+
+See docs/SHARDING.md for the lens algebra per sharding form and
+``parallel.mesh.data_fsdp_tensor_mesh`` for the mesh conventions.
+"""
+
+from kfac_pytorch_tpu.shardwise.lenses import (  # noqa: F401
+    EIGEN_KEYS,
+    ema_update,
+    eigen_refresh,
+    factor_leaf_spec,
+    has_moe,
+    has_shard_lens,
+    identity_eigen,
+    identity_factors,
+    is_shard_eigen_entry,
+    lm_param_shardings,
+    moe_ema,
+    precondition,
+    shard_entries,
+    state_bytes_local,
+)

@@ -1,0 +1,416 @@
+"""Shard lens algebra: K-FAC for sharded-parameter (TP/FSDP/MoE) kernels.
+
+The subsystem behind the ``#c{T}``/``#r{T}``/``#e{E}`` layer names
+(capture.split_shard_name): per-shard factor state layouts, the stacked
+eigen refresh, the shard-local preconditioning solves, the MoE
+token-count-weighted EMA, and the mesh placement rules that put each factor
+block on the device owning the matching kernel shard.
+
+Lens algebra (*KFAC for Modern Neural Network Architectures*, arxiv
+2311.00636, generalized to sharded kernels):
+
+* **column-sharded** (``#cT``, kernel ``[a, m]`` split along m): every shard
+  reads the full input → ONE replicated A ``[a(+1), a(+1)]``; shard outputs
+  are disjoint → G is exactly block-diagonal, a ``[T, m/T, m/T]`` stack.
+  Each shard's block is preconditioned shard-locally against the shared A
+  eigenbasis — ZERO extra collectives on the tensor axis.
+* **row-sharded** (``#rT``, kernel split along a): each shard reads its own
+  input slice → per-shard A stack ``[T, a/T, a/T]``; the output grad is the
+  forward psum's cotangent, identical on every shard → ONE G ``[m, m]``.
+* **MoE expert bank** (``#eE``, kernel ``[E, a, m]``): per-expert A/G stacks
+  with token-count-weighted EMAs (:func:`moe_ema`).
+
+State layout: factors keep the familiar ``{"A", "G"}`` keys at stacked
+shapes; eigen entries use FORM-PREFIXED keys (``cQA``/``cdA``/…,
+``rQA``/…, ``eQA``/…) so the generic singles/stacked split and the
+diagonal-A detection (ops/precondition.py) leave them alone — shardwise
+entries always travel as per-layer singletons and always refresh densely
+(the blocks are ``1/T`` the side of the unsharded factor; there is no eigh
+spike left to truncate, which is why ``solver="rsvd"`` composes: non-shard
+layers ride the solver, shard stacks stay dense).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu.ops import factors as factor_ops
+from kfac_pytorch_tpu.ops.eigh import symmetrize
+from kfac_pytorch_tpu.ops.precondition import precondition_mat
+
+PyTree = Any
+
+# Form-prefixed eigen keys: {form: (QA, dA, QG, dG)}.
+EIGEN_KEYS = {
+    "c": ("cQA", "cdA", "cQG", "cdG"),
+    "r": ("rQA", "rdA", "rQG", "rdG"),
+    "e": ("eQA", "edA", "eQG", "edG"),
+}
+
+# Floor matching the dense refresh (kfac_preconditioner.py:252-253).
+_EIG_EPS = 1e-10
+
+# Token-fraction floor for expert normalization: an expert with f_e = 0 gets
+# a zero batch stat and EMA weight alpha**0 = 1, i.e. its history is
+# untouched — tiny only guards the 0/0.
+_MOE_TINY = 1e-12
+
+
+def shard_entries(names: List[str]) -> Dict[str, Tuple[str, str, int]]:
+    """``{name: (base, form, count)}`` for every shard-lens name in ``names``."""
+    from kfac_pytorch_tpu import capture
+
+    out = {}
+    for n in names:
+        base, form, count = capture.split_shard_name(n)
+        if form is not None:
+            out[n] = (base, form, count)
+    return out
+
+
+def has_shard_lens(names: List[str]) -> bool:
+    """Any column/row-sharded (``#c``/``#r``) layer present?"""
+    return any(f in ("c", "r") for _, f, _ in shard_entries(names).values())
+
+
+def has_moe(names: List[str]) -> bool:
+    """Any MoE expert bank (``#e``) present?"""
+    return any(f == "e" for _, f, _ in shard_entries(names).values())
+
+
+# ---------------------------------------------------------------------------
+# State initialization
+# ---------------------------------------------------------------------------
+
+
+def identity_factors(
+    form: str, count: int, kernel_shape: Tuple[int, ...], has_bias: bool
+) -> Dict[str, jnp.ndarray]:
+    """Identity factor stacks for one shard-lens layer (init parity with the
+    dense layers' ``eye`` init)."""
+    if form == "c":
+        a_in, m = kernel_shape
+        sa = a_in + (1 if has_bias else 0)
+        gs = m // count
+        return {
+            "A": jnp.eye(sa, dtype=jnp.float32),
+            "G": jnp.broadcast_to(
+                jnp.eye(gs, dtype=jnp.float32), (count, gs, gs)
+            ),
+        }
+    if form == "r":
+        a_in, m = kernel_shape
+        a_s = a_in // count
+        return {
+            "A": jnp.broadcast_to(
+                jnp.eye(a_s, dtype=jnp.float32), (count, a_s, a_s)
+            ),
+            "G": jnp.eye(m, dtype=jnp.float32),
+        }
+    if form == "e":
+        e, a_in, m = kernel_shape
+        return {
+            "A": jnp.broadcast_to(
+                jnp.eye(a_in, dtype=jnp.float32), (count, a_in, a_in)
+            ),
+            "G": jnp.broadcast_to(
+                jnp.eye(m, dtype=jnp.float32), (count, m, m)
+            ),
+        }
+    raise ValueError(f"unknown shard form {form!r}")
+
+
+def identity_eigen(form: str, facs: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Identity eigenbases matching :func:`identity_factors` (Q = I, d = 1)."""
+    qa_k, da_k, qg_k, dg_k = EIGEN_KEYS[form]
+    a_f, g_f = facs["A"], facs["G"]
+    return {
+        qa_k: jnp.broadcast_to(
+            jnp.eye(a_f.shape[-1], dtype=jnp.float32), a_f.shape
+        ),
+        da_k: jnp.ones(a_f.shape[:-1], jnp.float32),
+        qg_k: jnp.broadcast_to(
+            jnp.eye(g_f.shape[-1], dtype=jnp.float32), g_f.shape
+        ),
+        dg_k: jnp.ones(g_f.shape[:-1], jnp.float32),
+    }
+
+
+def is_shard_eigen_entry(entry: Dict[str, jnp.ndarray]) -> bool:
+    """Whether an eigen-state entry carries form-prefixed shardwise keys."""
+    return any(keys[0] in entry for keys in EIGEN_KEYS.values())
+
+
+# ---------------------------------------------------------------------------
+# Factor EMA
+# ---------------------------------------------------------------------------
+
+
+def ema_update(
+    form: str,
+    current: Dict[str, jnp.ndarray],
+    a_new: Any,
+    g_new: jnp.ndarray,
+    alpha: float,
+) -> Dict[str, jnp.ndarray]:
+    """One factor-EMA step for a shard-lens layer.
+
+    Column/row stacks update elementwise (``update_running_avg`` broadcasts
+    over the shard dim — linear, so deferred comm merges stay exact). MoE
+    routes to :func:`moe_ema`.
+    """
+    if form == "e":
+        return moe_ema(current, a_new, g_new, alpha)
+    return {
+        "A": factor_ops.update_running_avg(a_new, current["A"], alpha),
+        "G": factor_ops.update_running_avg(g_new, current["G"], alpha),
+    }
+
+
+def moe_ema(
+    current: Dict[str, jnp.ndarray],
+    a_new: Dict[str, jnp.ndarray],
+    g_new: jnp.ndarray,
+    alpha: float,
+) -> Dict[str, jnp.ndarray]:
+    """Token-count-weighted per-expert EMA.
+
+    ``a_new`` is the capture pair ``{"S": [E, a, a], "f": [E]}`` — the
+    UNNORMALIZED covariance sums (global-1/N scaled) plus the token
+    fractions, both linear in per-token contributions, so a cross-replica
+    pmean of the pair commutes with this normalization:
+
+        A_batch_e = S_e / max(f_e, tiny)       (per-expert mean outer product)
+        w_e       = f_e · E                     (1 at uniform routing)
+        α_e       = α ** w_e
+        A'_e      = α_e · A_e + (1 − α_e) · A_batch_e
+
+    An expert that saw no tokens has f_e = 0 → α_e = 1 → its history is
+    bit-untouched; an over-dispatched expert decays its history faster, so
+    every expert's EMA tracks the SAME effective per-token horizon.
+    """
+    s, f = a_new["S"], a_new["f"]
+    e = f.shape[0]
+    denom = jnp.maximum(f, _MOE_TINY)[:, None, None]
+    a_batch = s / denom
+    g_batch = g_new / denom
+    alpha_e = jnp.asarray(alpha, jnp.float32) ** (f * e)
+    ae = alpha_e[:, None, None]
+    return {
+        "A": ae * current["A"] + (1.0 - ae) * a_batch,
+        "G": ae * current["G"] + (1.0 - ae) * g_batch,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Eigen refresh
+# ---------------------------------------------------------------------------
+
+
+def _eigh_floored(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(Batched) symmetric eigh with the reference eigenvalue floor."""
+    d, q = jnp.linalg.eigh(symmetrize(x.astype(jnp.float32)))
+    return q, d * (d > _EIG_EPS).astype(d.dtype)
+
+
+def eigen_refresh(
+    form: str, facs: Dict[str, jnp.ndarray]
+) -> Dict[str, jnp.ndarray]:
+    """Refresh one shard-lens layer's eigen entry from its factor stacks.
+
+    Always the DENSE decomposition, batched over the stack dim where the
+    side is stacked: the blocks are 1/T (or per-expert) sized, so there is
+    no whole-factor eigh spike to chunk/truncate/stream — which is exactly
+    why every refresh-shaping lever (eigh_chunks, solver="streaming",
+    diag_blocks, the curvature service) refuses shard-lens layers
+    (planner rules shard_lens_vs_*). Runs replicated on every device:
+    factor stacks are either replicated or tensor-axis-sharded with the
+    matching grad shard local, so no assignment table is needed.
+    """
+    qa_k, da_k, qg_k, dg_k = EIGEN_KEYS[form]
+    qa, da = _eigh_floored(facs["A"])
+    qg, dg = _eigh_floored(facs["G"])
+    return {qa_k: qa, da_k: da, qg_k: qg, dg_k: dg}
+
+
+# ---------------------------------------------------------------------------
+# Preconditioning
+# ---------------------------------------------------------------------------
+
+
+def precondition(
+    form: str,
+    count: int,
+    grad_mat: jnp.ndarray,
+    entry: Dict[str, jnp.ndarray],
+    damping: jnp.ndarray,
+) -> jnp.ndarray:
+    """Apply the shard-lens ``(G ⊗ A + λI)⁻¹`` to one layer's grad mat.
+
+    Shapes in/out match capture.grad_mats: ``[m, a(+1)]`` for column/row
+    (shard blocks split and re-merged here, in factor space), ``[E, m, a]``
+    for MoE. Each block solve is the ordinary eigenbasis rotation
+    (ops/precondition.precondition_mat) vmapped over the stack dim.
+    """
+    qa_k, da_k, qg_k, dg_k = EIGEN_KEYS[form]
+    qa, da, qg, dg = entry[qa_k], entry[da_k], entry[qg_k], entry[dg_k]
+    if form == "c":
+        m, sa = grad_mat.shape
+        gm = grad_mat.reshape(count, m // count, sa)
+        v = jax.vmap(
+            lambda g, q, d: precondition_mat(g, qa, q, da, d, damping)
+        )(gm, qg, dg)
+        return v.reshape(m, sa)
+    if form == "r":
+        m, a_in = grad_mat.shape
+        gm = jnp.transpose(
+            grad_mat.reshape(m, count, a_in // count), (1, 0, 2)
+        )  # [T, m, a/T]
+        v = jax.vmap(
+            lambda g, q, d: precondition_mat(g, q, qg, d, dg, damping)
+        )(gm, qa, da)
+        return jnp.transpose(v, (1, 0, 2)).reshape(m, a_in)
+    if form == "e":
+        return jax.vmap(
+            lambda g, qae, dae, qge, dge: precondition_mat(
+                g, qae, qge, dae, dge, damping
+            )
+        )(grad_mat, qa, da, qg, dg)
+    raise ValueError(f"unknown shard form {form!r}")
+
+
+# ---------------------------------------------------------------------------
+# Mesh placement
+# ---------------------------------------------------------------------------
+
+
+def _tensor_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    if mesh is None:
+        return None
+    for a in mesh.axis_names:
+        if str(a).startswith("tensor") and int(mesh.shape[a]) > 1:
+            return str(a)
+    return None
+
+
+def _fsdp_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    if mesh is None:
+        return None
+    for a in mesh.axis_names:
+        if str(a).startswith("fsdp") and int(mesh.shape[a]) > 1:
+            return str(a)
+    return None
+
+
+def factor_leaf_spec(
+    name: str, key: str, leaf_shape: Tuple[int, ...], mesh: Optional[Mesh]
+) -> P:
+    """PartitionSpec for one shardwise factor/eigen leaf.
+
+    Column layers shard the G-side stacks over the tensor axis (each device
+    holds the block matching its kernel column shard); row layers shard the
+    A-side stacks the same way. Replicated otherwise — including whenever
+    the stack dim does not divide by the tensor axis (a 4-shard lens on a
+    2-wide tensor axis still places 2 blocks per device).
+    """
+    from kfac_pytorch_tpu import capture
+
+    _, form, count = capture.split_shard_name(name)
+    axis = _tensor_axis(mesh)
+    if form is None or axis is None:
+        return P()
+    tp = int(mesh.shape[axis])
+    if not leaf_shape or leaf_shape[0] != count or count % tp:
+        return P()
+    sharded_keys = {
+        "c": ("G", "cQG", "cdG"),
+        "r": ("A", "rQA", "rdA"),
+        "e": (),
+    }[form]
+    if key in sharded_keys:
+        return P(axis)
+    return P()
+
+
+def lm_param_shardings(
+    params: PyTree, names: List[str], mesh: Mesh
+) -> PyTree:
+    """NamedShardings placing shard-lens kernels on the 3-D mesh.
+
+    Column kernels ``[a, m]`` split their output columns over the tensor
+    axis (``P(None, 'tensor')``, bias ``P('tensor')``); row kernels split
+    their input rows (``P('tensor', None)``); MoE banks stay replicated
+    (experts are toy-scale). Every OTHER param shards its leading dim over
+    the fsdp axis when present and divisible — flax hands the layer the
+    full (allgathered) value, so standard dense capture IS capture at the
+    allgather point. Everything else replicates.
+    """
+    entries = shard_entries(names)
+    t_axis = _tensor_axis(mesh)
+    f_axis = _fsdp_axis(mesh)
+    specs: Dict[Tuple[str, ...], P] = {}
+    for base, form, count in entries.values():
+        path = tuple(base.split("/"))
+        if form == "c" and t_axis is not None:
+            specs[path + ("kernel",)] = P(None, t_axis)
+            specs[path + ("bias",)] = P(t_axis)
+        elif form == "r" and t_axis is not None:
+            specs[path + ("kernel",)] = P(t_axis, None)
+
+    def _leaf_spec(path, leaf):
+        keys = tuple(
+            p.key if isinstance(p, jax.tree_util.DictKey) else str(p)
+            for p in path
+        )
+        if keys in specs:
+            tp = int(mesh.shape[t_axis])
+            dim = 1 if specs[keys] == P(None, t_axis) else 0
+            if leaf.ndim > dim and leaf.shape[dim] % tp == 0:
+                return NamedSharding(mesh, specs[keys])
+            return NamedSharding(mesh, P())
+        if (
+            f_axis is not None
+            and leaf.ndim >= 1
+            and leaf.shape[0] % int(mesh.shape[f_axis]) == 0
+            and leaf.size >= 2 * int(mesh.shape[f_axis])
+        ):
+            return NamedSharding(
+                mesh, P(*((f_axis,) + (None,) * (leaf.ndim - 1)))
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(_leaf_spec, params)
+
+
+def state_bytes_local(tree: PyTree, specs: PyTree, mesh: Optional[Mesh]) -> int:
+    """Per-device bytes of a (state) pytree under PartitionSpec placement.
+
+    The compile-only memory accounting behind the sharded-vs-replicated
+    pin: each leaf's bytes divide by the product of the mesh axis sizes its
+    spec shards over (GSPMD stores exactly that slice per device).
+    """
+    total = 0
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    spec_leaves = dict(
+        (jax.tree_util.keystr(p), s)
+        for p, s in jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, (P, NamedSharding))
+        )
+    )
+    for path, leaf in leaves:
+        spec = spec_leaves.get(jax.tree_util.keystr(path), P())
+        if isinstance(spec, NamedSharding):
+            spec = spec.spec
+        div = 1
+        if mesh is not None:
+            for entry in spec:
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                for a in axes:
+                    if a is not None:
+                        div *= int(mesh.shape[a])
+        total += leaf.size * leaf.dtype.itemsize // max(div, 1)
+    return total

@@ -33,27 +33,42 @@ from kfac_pytorch_tpu.preconditioner import KFAC
 PyTree = Any
 
 
-def require_pure_dp_mesh(mesh) -> str:
+def require_pure_dp_mesh(mesh):
     """The compressed-grad wrappers need every device to see whole examples:
-    returns the batch axis name, rejecting meshes with a real second axis.
+    returns the batch axis name(s), rejecting meshes with a real second axis.
 
     Axes named ``tensor*`` are exempt (parallel/mesh.py::data_tensor_mesh):
     by convention they are replicated-compute — parameters and batch carry
     ``P()`` over them, so every tensor replica still sees whole examples and
-    all K-FAC/grad collectives stay confined to the data axis.
+    all K-FAC/grad collectives stay confined to the data axis. Axes named
+    ``fsdp*`` (parallel/mesh.py::data_fsdp_tensor_mesh) are batch-CARRYING:
+    parameters shard their leading dim over them but the batch shards too,
+    so each device still sees whole examples — they join the returned
+    reduction axis, which is then a TUPLE ``('data', 'fsdp')`` (both
+    ``PartitionSpec`` dim entries and ``lax.pmean``/``psum`` axis arguments
+    accept tuples transparently). Pure-DP meshes keep returning the plain
+    string so existing single-axis callers are untouched.
     """
     bad = [
         a
         for a in mesh.axis_names[1:]
-        if mesh.shape[a] > 1 and not str(a).startswith("tensor")
+        if mesh.shape[a] > 1
+        and not (str(a).startswith("tensor") or str(a).startswith("fsdp"))
     ]
     if bad:
         raise ValueError(
             "grad_comm_dtype requires a data-plane mesh (non-data axes of "
-            f"size 1 or named 'tensor*'); got {dict(mesh.shape)} — a "
+            f"size 1 or named 'tensor*'/'fsdp*'); got {dict(mesh.shape)} — a "
             "sequence/model axis would make the per-device local forward "
             "see a partial example"
         )
+    fsdp = tuple(
+        str(a)
+        for a in mesh.axis_names[1:]
+        if str(a).startswith("fsdp") and mesh.shape[a] > 1
+    )
+    if fsdp:
+        return (mesh.axis_names[0],) + fsdp
     return mesh.axis_names[0]
 
 

@@ -303,6 +303,128 @@ def _check_2d_mesh() -> int:
     return 0
 
 
+class _ShardNet(nn.Module):
+    """Column + row sharded kernels plus one replicated dense layer — the
+    three factor families of the 3-D pin."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        from kfac_pytorch_tpu.models.layers import KFACShardedDense
+
+        h = nn.gelu(
+            KFACShardedDense(16, 2, sharding="column", name="col")(x)
+        )
+        h = KFACShardedDense(
+            12, 2, sharding="row", use_bias=False, name="row"
+        )(h)
+        return KFACDense(10, name="fc")(h)
+
+
+def _check_3d_mesh() -> int:
+    """3-D data×fsdp×tensor pin (docs/SHARDING.md): with params placed via
+    shardwise.lm_param_shardings and factors via KFAC.state_shardings, the
+    factor capture path must add collectives ONLY in joint data×fsdp
+    replica groups (size data_world·fsdp_world). Zero tensor-axis
+    additions: the column-sharded G stack is captured and preconditioned
+    shard-locally, the row-sharded A slices are local to their shard, and
+    the row output-grad psum is the forward matmul's own reduction —
+    present in the plain variant too, so the capture delta on the tensor
+    axis is exactly the predicted per-shard psum set: empty."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kfac_pytorch_tpu import capture, shardwise
+    from kfac_pytorch_tpu.parallel.mesh import data_fsdp_tensor_mesh
+
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    factor_world = mesh.shape["data"] * mesh.shape["fsdp"]
+    model = _ShardNet()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 8).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=16))
+    layers = capture.discover_layers(model, x, train=True)
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                mesh=mesh, layers=layers)
+    tx = make_sgd(momentum=0.9)
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    pshard = shardwise.lm_param_shardings(params, layers, mesh)
+    kstate = jax.device_put(
+        state.kfac_state, kfac.state_shardings(state.kfac_state)
+    )
+    state = state.replace(params=None, kfac_state=None)
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    state = state.replace(
+        params=jax.device_put(params, pshard), kfac_state=kstate
+    )
+    batch = tuple(
+        jax.device_put(b, NamedSharding(mesh, P(("data", "fsdp"))))
+        for b in (x, y)
+    )
+    step_fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    lr, damping = jnp.float32(0.1), jnp.float32(0.01)
+
+    def hist(**flags):
+        """(op, replica-group size) → instruction count."""
+        hlo = step_fn.lower(
+            state, batch, lr, damping, **flags
+        ).compile().as_text()
+        out = {}
+        for op, rx in (
+            ("all-reduce", _ALLREDUCE_RE),
+            ("reduce-scatter", _REDUCE_SCATTER_RE),
+            ("all-gather", _ALLGATHER_RE),
+        ):
+            for ln in hlo.splitlines():
+                if rx.search(ln):
+                    sizes = _group_sizes(ln) or [mesh.size]
+                    out[(op, sizes[0])] = out.get((op, sizes[0]), 0) + 1
+        return out
+
+    plain = hist(update_factors=False, update_eigen=False)
+    cap = hist(update_factors=True, update_eigen=False)
+    delta = {
+        k: cap.get(k, 0) - plain.get(k, 0) for k in set(cap) | set(plain)
+    }
+    off_axis = {
+        f"{op}@{size}": n for (op, size), n in sorted(delta.items())
+        if n > 0 and (op, size) != ("all-reduce", factor_world)
+    }
+    added = delta.get(("all-reduce", factor_world), 0)
+    print(
+        f"check_collective_count: 3-D mesh ({dict(mesh.shape)}) capture "
+        f"delta {added} all-reduce(s) in data×fsdp groups of {factor_world}; "
+        f"off-axis additions: {off_axis or 'none'}"
+    )
+    if off_axis:
+        print(
+            "check_collective_count: FAIL — the 3-D factor path added "
+            f"collectives outside the data×fsdp replica groups: {off_axis}. "
+            "The tensor axis must stay capture-collective-free (per-shard "
+            "G/A blocks live where their kernel shard lives)",
+            file=sys.stderr,
+        )
+        return 1
+    if cap.get(("all-reduce", factor_world), 0) < 1:
+        print(
+            "check_collective_count: FAIL — 3-D capture step carries no "
+            f"all-reduce in data×fsdp groups of {factor_world}; the factor "
+            "statistics are not being exchanged across replicas",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        "check_collective_count: OK — 3-D mesh factor exchange confined to "
+        f"data×fsdp groups of {factor_world}, zero tensor-axis additions"
+    )
+    return 0
+
+
 def _check_embed_memory() -> int:
     """Compile-only memory pin: the token-gather embedding capture must not
     materialize the one-hot program — temp bytes < dense oracle / 10."""
@@ -403,6 +525,9 @@ def main() -> int:
     if rc:
         return rc
     rc = _check_2d_mesh()
+    if rc:
+        return rc
+    rc = _check_3d_mesh()
     if rc:
         return rc
     return _check_embed_memory()

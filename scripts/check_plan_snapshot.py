@@ -118,6 +118,35 @@ _TRANSFORMER_LM = {
     "tok_embed": (512, 32768),
 }
 
+# --- fixture 6: the LM on a v5e-32 3-D data×fsdp×tensor mesh (8 × 2 × 2,
+# parallel/mesh.py::data_fsdp_tensor_mesh) with the MLP genuinely
+# Megatron-split (--fsdp 2 --tensor-parallel 2): ff1 column-shards
+# ("#c2", per-block G side 1024), ff2 row-shards ("#r2", per-block
+# bias-free A side 1024). Shapes hold the PER-BLOCK sides; shard_counts
+# carries (form, T). The snapshot pins the shard-lens exclusions firing
+# by name (owner/chunks/streaming refused for the run, not silently),
+# the surviving wire levers, and owner sizing to the BATCH world
+# data×fsdp = 16, not the 32-device total.
+_TRANSFORMER_LM_SHARDED = {
+    **{
+        f"block_{i}/{lay}": shape
+        for i in range(4)
+        for lay, shape in (
+            ("qkv", (1536, 513)),
+            ("out", (512, 513)),
+            ("ff1#c2", (1024, 513)),
+            ("ff2#r2", (512, 1024)),
+        )
+    },
+    "decoder": (32768, 513),
+    "tok_embed": (512, 32768),
+}
+
+_TRANSFORMER_LM_SHARD_COUNTS = {
+    **{f"block_{i}/ff1#c2": ("c", 2) for i in range(4)},
+    **{f"block_{i}/ff2#r2": ("r", 2) for i in range(4)},
+}
+
 FIXTURES = {
     "cifar_resnet32_x8": dict(
         shapes=_CIFAR_RESNET32,
@@ -162,6 +191,15 @@ FIXTURES = {
     # factors (service_vs_owner_sharding), wire/overlap levers intact. At
     # the default K=100 the same offer is declined (refresh amortizes
     # below the carved devices' capture loss) — fixture 2 pins that side.
+    "transformer_lm_x8x2x2": dict(
+        shapes=_TRANSFORMER_LM_SHARDED,
+        shard_counts=_TRANSFORMER_LM_SHARD_COUNTS,
+        diag_a=("tok_embed",),
+        has_conv=False,
+        world=32,
+        data_world=16,
+        mesh_axes=("data", "fsdp", "tensor"),
+    ),
     "resnet50_x32_service": dict(
         shapes=_RESNET50,
         diag_a=(),
@@ -183,6 +221,9 @@ def resolve_fixture(name: str) -> dict:
         shapes={k: tuple(v) for k, v in fx["shapes"].items()},
         diag_a=frozenset(fx["diag_a"]),
         has_conv=fx["has_conv"],
+        shard_counts={
+            k: (f, int(c)) for k, (f, c) in fx.get("shard_counts", {}).items()
+        },
     )
     env = PlanEnv(
         world=fx["world"],
@@ -191,6 +232,8 @@ def resolve_fixture(name: str) -> dict:
         on_tpu=True,
         has_diag_a_layers=facts.has_diag_a,
         has_conv_layers=facts.has_conv,
+        has_shard_lens_layers=facts.has_shard_lens,
+        has_moe_layers=facts.has_moe,
         fac_update_freq=fx.get("fac_update_freq", 10),
         kfac_update_freq=fx.get("kfac_update_freq", 100),
         service_devices=fx.get("service_devices", 0),

@@ -324,6 +324,78 @@ out["svc_resume_bitwise"] = bool(all(
         jax.tree_util.tree_leaves(jax.device_get(r7.params)),
     )
 ))
+# PR-15: owner sharding + scatter_merge + deferred-comm snapshot/resume
+# through the 3-D data×fsdp×tensor mesh path on a REAL 2-process world.
+# The snapshot lands OFF the flush boundary (factor_sync_age == 1), so a
+# bitwise resume proves pack_replica_local's cross-host packing of the
+# per-replica factor_local accumulators is lossless: each process writes
+# its own devices' accumulator rows (flat-mesh sharded global array), and
+# unpack re-places them divergent-per-device on restore.
+from kfac_pytorch_tpu.parallel.mesh import data_fsdp_tensor_mesh, put_sharded_batch
+
+mesh3 = data_fsdp_tensor_mesh(2, 1)  # data=2, fsdp=2, tensor=1 over 4 devices
+assert tuple(mesh3.axis_names) == ("data", "fsdp", "tensor")
+own_kw = dict(damping=0.003, mesh=mesh3, factor_sharding="owner",
+              factor_comm_freq=3, fac_update_freq=1, kfac_update_freq=4)
+batch3 = put_sharded_batch(
+    mesh3, (X[pid * 2:(pid + 1) * 2], Y[pid * 2:(pid + 1) * 2]),
+    P(("data", "fsdp")))
+
+def _owner3d_build():
+    k = KFAC(**own_kw)
+    p = _fresh_params()
+    s = TrainState(step=jnp.zeros((), jnp.int32), params=p, batch_stats={},
+                   opt_state=tx.init(p), kfac_state=k.init(p))
+    ks = s.kfac_state
+    s = jax.device_put(s.replace(kfac_state=None), NamedSharding(mesh3, P()))
+    ks = jax.jit(lambda t: t, out_shardings=k.state_shardings(ks))(ks)
+    s = s.replace(kfac_state=ks)
+    f = make_train_step(model, tx, k, train_kwargs={"train": True})
+    return k, s, f
+
+def _owner3d_run(f, cad, s, lo, hi):
+    for i in range(lo, hi):
+        s, _ = f(s, batch3, jnp.float32(0.05), jnp.float32(0.003),
+                 **cad.flags_for_step(i))
+    return s
+
+kfacA, stA, fnA = _owner3d_build()
+cadA = EigenRefreshCadence(kfacA)
+stA = _owner3d_run(fnA, cadA, stA, 0, 6)  # flushes at 0/3/4; age 1 at snap
+out["owner3d_sync_age"] = int(jax.device_get(stA.kfac_state["factor_sync_age"]))
+snap3 = os.path.join(os.environ["KFAC_SNAPDIR"], "owner3d")
+supA = Supervisor(snap3, kfac=kfacA, cadence=cadA)
+supA.snapshot(6, stA, sync=True)
+launch.barrier("owner3d-snap")
+stA = _owner3d_run(fnA, cadA, stA, 6, 10)  # covers flush at 6, refresh at 8
+out["owner3d_param_sum"] = _psum(stA.params)
+
+kfacB, stB, fnB = _owner3d_build()
+cadB = EigenRefreshCadence(kfacB)
+supB = Supervisor(snap3, kfac=kfacB, cadence=cadB)
+# host-side zeros template: the owner-sharded live state is not fully
+# addressable per process, so device_get cannot build the restore target
+targetB = jax.tree_util.tree_map(lambda l: np.zeros(l.shape, l.dtype), stB)
+hitB = supB.scan_resume(targetB)
+assert hitB is not None, "no owner-3d snapshot found on resume"
+rB, manifestB, rstepB = hitB
+assert rstepB == 6, rstepB
+out["owner3d_packed"] = bool(manifestB["packed_replica_local"])
+out["owner3d_packed_world"] = manifestB.get("packed_world")
+out["owner3d_world"] = manifestB.get("world")
+ksB = rB.kfac_state
+rB = jax.device_put(rB.replace(kfac_state=None), NamedSharding(mesh3, P()))
+rB = rB.replace(kfac_state=ksB)
+rB = _owner3d_run(fnB, cadB, rB, 6, 10)
+out["owner3d_resume_bitwise"] = bool(all(
+    np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(stA.params)),
+        jax.tree_util.tree_leaves(jax.device_get(rB.params)),
+    )
+))
+out["owner3d_resume_param_sum"] = _psum(rB.params)
+
 print("RESULT " + json.dumps(out), flush=True)
 """
 
@@ -562,6 +634,25 @@ def test_service_split_role_snapshot_resume(world):
     assert r0["svc_resume_basis_version"] == r1["svc_resume_basis_version"] == 1
     assert r0["svc_resume_basis_sha"] == r0["svc_basis_sha"][1]
     assert r1["svc_resume_basis_sha"] == r1["svc_basis_sha"][1]
+
+
+def test_owner3d_deferred_snapshot_resume_lossless(world):
+    """PR-15: owner sharding + scatter_merge over the 3-D data×fsdp×tensor
+    mesh, snapshot taken OFF the flush boundary (factor_sync_age == 1).
+    The manifest records the cross-host pack (4 per-device accumulator
+    rows over a 4-replica owner world), and the resumed run — which must
+    re-place every process's own factor_local rows — finishes bitwise
+    equal to the uninterrupted one on BOTH processes: deferred
+    accumulation is lossless across hosts, not just on flush boundaries."""
+    r0, r1 = world
+    assert r0["owner3d_sync_age"] == r1["owner3d_sync_age"] == 1
+    assert r0["owner3d_packed"] and r1["owner3d_packed"]
+    assert r0["owner3d_packed_world"] == r1["owner3d_packed_world"] == 4
+    assert r0["owner3d_world"] == 4  # data×fsdp replicas on the 3-D mesh
+    assert r0["owner3d_resume_bitwise"] and r1["owner3d_resume_bitwise"]
+    assert r0["owner3d_param_sum"] == r1["owner3d_param_sum"]
+    assert r0["owner3d_resume_param_sum"] == r0["owner3d_param_sum"]
+    assert r1["owner3d_resume_param_sum"] == r1["owner3d_param_sum"]
 
 
 def test_stream_snapshot_resume_across_processes(world):

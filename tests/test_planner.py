@@ -211,6 +211,27 @@ _ENVS = {
     ),
     "multi_axis": (dict(axes=("data", "seq")), dict()),
     "single_device": (dict(world=1), dict()),
+    # shardwise model facts (kfac_pytorch_tpu/shardwise/): the KFAC kwargs
+    # carry shard-suffixed layer names so the constructor derives the same
+    # has_shard_lens/has_moe facts the env kwargs declare
+    "shard_lens": (
+        dict(has_shard_lens_layers=True),
+        dict(layers=["block_0/ff1#c2", "block_0/ff2#r2"]),
+    ),
+    "moe": (
+        dict(has_moe_layers=True),
+        dict(layers=["block_0/moe#e4"]),
+    ),
+    # env-vs-env rows (shard_lens_vs_inverse / _vs_diag_blocks) need the
+    # conflicting env features combined in ONE entry
+    "shard_lens_inverse": (
+        dict(has_shard_lens_layers=True, precond_method="inverse"),
+        dict(layers=["block_0/ff1#c2"], precond_method="inverse"),
+    ),
+    "shard_lens_diag_blocks": (
+        dict(has_shard_lens_layers=True, diag_blocks=2),
+        dict(layers=["block_0/ff1#c2"], diag_blocks=2),
+    ),
 }
 
 

@@ -78,13 +78,19 @@ def test_collective_count_check():
     means the FactorComm fusion regressed — and the owner-sharded capture
     step must pin to ≤ bucket-count reduce-scatters plus exactly one
     preconditioned-gradient all-gather, with the replicated baseline free
-    of both op kinds (scripts/check_collective_count.py)."""
+    of both op kinds (scripts/check_collective_count.py). The 3-D
+    data×fsdp×tensor section pins the shardwise factor exchange to joint
+    data×fsdp replica groups with ZERO tensor-axis additions — the
+    per-shard G/A blocks precondition where their kernel shard lives
+    (docs/SHARDING.md)."""
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "check_collective_count.py")],
         capture_output=True, text=True, cwd=REPO,
     )
     assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
     assert "OK" in res.stdout
+    assert "3-D mesh factor exchange confined" in res.stdout
+    assert "zero tensor-axis additions" in res.stdout
 
 
 def test_overlap_hlo_check():

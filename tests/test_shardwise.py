@@ -1,0 +1,422 @@
+"""Sharded-parameter K-FAC tests (kfac_pytorch_tpu/shardwise/).
+
+Parity oracles, per docs/SHARDING.md:
+
+* COLUMN-sharded dense ≡ the expand lens (``KFACDense(lens_splits=T)``):
+  same replicated A, per-output-slice G blocks — the two bookkeepings must
+  train identically (rtol 1e-6 over multiple eigen-refresh intervals).
+* ROW-sharded dense ≡ the sum of T independent bias-free ``KFACDense``
+  layers, each reading one input slice.
+* MoE capture ≡ the dense ``[N, E]`` one-hot scatter-add oracle, BITWISE
+  (the sparse path must never change the statistics, only skip the
+  densification), and the token-count-weighted EMA leaves an undispatched
+  expert's history bit-untouched.
+* 3-D-mesh placement (params via ``shardwise.lm_param_shardings``, factors
+  via ``KFAC.state_shardings``) ≡ replicated placement of the SAME model on
+  the SAME mesh — distribution must be numerics-neutral, including composed
+  with ``solver='rsvd'`` and ``factor_comm_freq>1``.
+
+Plus the per-device memory pin (``shardwise.state_bytes_local``) and the
+constructor refusals for every planner rule the shardwise family added.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kfac_pytorch_tpu import KFAC, capture, shardwise
+from kfac_pytorch_tpu.models import transformer_lm
+from kfac_pytorch_tpu.models.layers import (
+    KFACDense,
+    KFACShardedDense,
+)
+from kfac_pytorch_tpu.ops import factors as F
+from kfac_pytorch_tpu.parallel.mesh import (
+    batch_axes,
+    data_fsdp_tensor_mesh,
+    data_parallel_mesh,
+)
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+NCLS = 8
+VOCAB = 50
+
+
+def _cls_batch(b=16, cin=12, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(b, cin).astype(np.float32))
+    y = jnp.asarray(r.randint(0, NCLS, size=(b,)))
+    return x, y
+
+
+def _train(model, params, batch, steps=6, **kfac_kw):
+    """Six steps, eigen refresh every 2nd → three refresh intervals."""
+    x, _ = batch
+    # the train step donates its state — copy so the caller can reuse the
+    # same param tree for the oracle run
+    params = jax.tree_util.tree_map(lambda v: jnp.array(v, copy=True), params)
+    layers = capture.discover_layers(model, x, train=True)
+    kfac = KFAC(
+        damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+        layers=layers, **kfac_kw,
+    )
+    tx = make_sgd(momentum=0.9)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+        opt_state=tx.init(params), kfac_state=kfac.init(params),
+    )
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    losses = []
+    for i in range(steps):
+        state, m = step(
+            state, batch, jnp.float32(0.1), jnp.float32(0.01),
+            update_factors=True, update_eigen=i % 2 == 0,
+        )
+        losses.append(float(m["loss"]))
+    return jax.device_get(state.params), losses
+
+
+# ---------------------------------------------------------------------------
+# factor capture vs oracles (function level)
+# ---------------------------------------------------------------------------
+
+
+def test_column_factors_match_lens_slices_bitwise():
+    """[T, m/T, m/T] G stack rows = per-output-slice compute_g_dense."""
+    r = np.random.RandomState(1)
+    g = jnp.asarray(r.randn(24, 12).astype(np.float32))
+    stack = F.compute_g_dense_sharded(g, 3, batch_averaged=True)
+    for i in range(3):
+        want = F.compute_g_dense(g[:, i * 4:(i + 1) * 4], batch_averaged=True)
+        np.testing.assert_array_equal(np.asarray(stack[i]), np.asarray(want))
+
+
+def test_row_factors_match_input_slices_bitwise():
+    """[T, a/T, a/T] A stack rows = per-input-slice compute_a_dense."""
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(24, 12).astype(np.float32))
+    stack = F.compute_a_row_sharded(x, 3)
+    for i in range(3):
+        want = F.compute_a_dense(x[:, i * 4:(i + 1) * 4], has_bias=False)
+        np.testing.assert_array_equal(np.asarray(stack[i]), np.asarray(want))
+
+
+def test_moe_capture_matches_onehot_oracle_bitwise():
+    """Sparse per-expert covariance sums = dense one-hot scatter-add."""
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(32, 6).astype(np.float32))
+    ids = jnp.asarray(r.randint(0, 4, size=(32,)))
+    sparse = F.compute_a_moe(x, ids, 4)
+    dense = F.compute_a_moe_onehot(x, ids, 4)
+    np.testing.assert_array_equal(np.asarray(sparse), np.asarray(dense))
+
+
+def test_moe_ema_token_weighted():
+    """α_e = α^(f_e·E): empty expert bit-untouched, the rest follow the
+    manual per-expert formula."""
+    E, a, m = 3, 5, 4
+    r = np.random.RandomState(4)
+    cur = {
+        "A": jnp.asarray(r.randn(E, a, a).astype(np.float32)),
+        "G": jnp.asarray(r.randn(E, m, m).astype(np.float32)),
+    }
+    f = jnp.asarray([0.75, 0.25, 0.0], jnp.float32)  # expert 2: no tokens
+    s = jnp.asarray(r.randn(E, a, a).astype(np.float32)) * f[:, None, None]
+    g = jnp.asarray(r.randn(E, m, m).astype(np.float32)) * f[:, None, None]
+    out = shardwise.moe_ema(cur, {"S": s, "f": f}, g, 0.9)
+    np.testing.assert_array_equal(np.asarray(out["A"][2]), np.asarray(cur["A"][2]))
+    np.testing.assert_array_equal(np.asarray(out["G"][2]), np.asarray(cur["G"][2]))
+    for e in range(2):
+        fe = float(f[e])
+        ae = 0.9 ** (fe * E)
+        np.testing.assert_allclose(
+            np.asarray(out["A"][e]),
+            ae * np.asarray(cur["A"][e]) + (1 - ae) * np.asarray(s[e]) / fe,
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+# ---------------------------------------------------------------------------
+# training parity vs replicated oracles (≥ 2 refresh intervals)
+# ---------------------------------------------------------------------------
+
+
+class _ColNet(nn.Module):
+    sharded: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        if self.sharded:
+            h = KFACShardedDense(16, 2, sharding="column", name="fc1")(x)
+        else:
+            h = KFACDense(16, lens_splits=2, name="fc1")(x)
+        h = nn.gelu(h)
+        return KFACDense(NCLS, name="out")(h)
+
+
+def test_column_training_matches_lens_splits_oracle():
+    batch = _cls_batch()
+    oracle = _ColNet(sharded=False)
+    params = oracle.init(jax.random.PRNGKey(0), batch[0], train=True)["params"]
+    p_orc, l_orc = _train(oracle, params, batch)
+    p_shd, l_shd = _train(_ColNet(sharded=True), params, batch)
+    np.testing.assert_allclose(l_shd, l_orc, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        p_shd, p_orc,
+    )
+    assert l_shd[-1] < l_shd[0]
+
+
+class _RowNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        h = jnp.tanh(KFACDense(16, name="fc0")(x))
+        return KFACShardedDense(
+            NCLS, 2, sharding="row", use_bias=False, name="fc1"
+        )(h)
+
+
+class _RowOracle(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        h = jnp.tanh(KFACDense(16, name="fc0")(x))
+        return (
+            KFACDense(NCLS, use_bias=False, name="fc1a")(h[..., :8])
+            + KFACDense(NCLS, use_bias=False, name="fc1b")(h[..., 8:])
+        )
+
+
+def test_row_training_matches_slice_sum_oracle():
+    batch = _cls_batch(seed=5)
+    sharded = _RowNet()
+    p_s = sharded.init(jax.random.PRNGKey(1), batch[0], train=True)["params"]
+    p_o = {
+        "fc0": p_s["fc0"],
+        "fc1a": {"kernel": p_s["fc1"]["kernel"][:8]},
+        "fc1b": {"kernel": p_s["fc1"]["kernel"][8:]},
+    }
+    got_s, l_s = _train(sharded, p_s, batch)
+    got_o, l_o = _train(_RowOracle(), p_o, batch)
+    np.testing.assert_allclose(l_s, l_o, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(got_s["fc1"]["kernel"]),
+        np.concatenate(
+            [got_o["fc1a"]["kernel"], got_o["fc1b"]["kernel"]], axis=0
+        ),
+        rtol=1e-6, atol=1e-7,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        got_s["fc0"], got_o["fc0"],
+    )
+
+
+def test_moe_lm_training_decreases_loss():
+    model = transformer_lm.get_model(
+        VOCAB, max_len=16, d_model=32, n_heads=2, n_layers=1, moe_experts=2
+    )
+    r = np.random.RandomState(6)
+    toks = r.randint(0, VOCAB, size=(8, 17))
+    batch = (jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:]))
+    params = model.init(jax.random.PRNGKey(0), batch[0], train=True)["params"]
+    _, losses = _train(model, params, batch)
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# 3-D mesh: placement neutrality + memory pin
+# ---------------------------------------------------------------------------
+
+
+def _lm_3d_run(mesh, place_sharded, steps=4, **kfac_kw):
+    model = transformer_lm.get_model(
+        VOCAB, max_len=16, d_model=16, n_heads=2, n_layers=1,
+        tensor_parallel=2,
+    )
+    r = np.random.RandomState(7)
+    toks = r.randint(0, VOCAB, size=(8, 17))
+    batch = (jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:]))
+    batch = jax.device_put(
+        batch, NamedSharding(mesh, P(("data", "fsdp"), None))
+    )
+    params = model.init(jax.random.PRNGKey(0), batch[0], train=True)["params"]
+    layers = capture.discover_layers(model, batch[0], train=True)
+    kfac = KFAC(
+        damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+        mesh=mesh, layers=layers, **kfac_kw,
+    )
+    tx = make_sgd(momentum=0.9)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+        opt_state=tx.init(params), kfac_state=kfac.init(params),
+    )
+    if place_sharded:
+        pshard = shardwise.lm_param_shardings(params, layers, mesh)
+        kstate = jax.device_put(
+            state.kfac_state, kfac.state_shardings(state.kfac_state)
+        )
+        state = state.replace(params=None, kfac_state=None)
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        state = state.replace(
+            params=jax.device_put(params, pshard), kfac_state=kstate
+        )
+    else:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+    step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    losses = []
+    for i in range(steps):
+        flags = dict(update_factors=True, update_eigen=i % 2 == 0)
+        if kfac.factor_comm.defer and flags["update_eigen"]:
+            # hand-rolled schedule: deferred comm must flush before a refresh
+            flags["flush_factors"] = True
+        state, m = step(
+            state, batch, jnp.float32(0.1), jnp.float32(0.01), **flags
+        )
+        losses.append(float(m["loss"]))
+    return jax.device_get(state.params), losses
+
+
+def test_sharded_placement_matches_replicated_oracle():
+    """Same 3-D mesh, same model: device-sharded params + per-shard factor
+    placement vs everything replicated — placement is numerics-neutral."""
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    p_s, l_s = _lm_3d_run(mesh, place_sharded=True)
+    p_r, l_r = _lm_3d_run(mesh, place_sharded=False)
+    np.testing.assert_allclose(l_s, l_r, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        p_s, p_r,
+    )
+
+
+def test_sharded_placement_parity_composes_rsvd_and_deferred_comm():
+    """The same neutrality composed with solver='rsvd' (truncated refresh
+    on the NON-shard layers; shard stacks always refresh dense-batched) and
+    factor_comm_freq=2 (deferred factor exchange)."""
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    kw = dict(
+        solver="rsvd", solver_rank=8, solver_auto_threshold=32,
+        factor_comm_freq=2,
+    )
+    p_s, l_s = _lm_3d_run(mesh, place_sharded=True, **kw)
+    p_r, l_r = _lm_3d_run(mesh, place_sharded=False, **kw)
+    np.testing.assert_allclose(l_s, l_r, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        p_s, p_r,
+    )
+
+
+def test_sharded_factor_state_bytes_under_half_replicated():
+    """The compile-only memory pin: per-device factor+eigen bytes of the
+    2-way-sharded MLP kernels stay under HALF the replicated (dense-model)
+    bytes — block-diagonalization plus tensor-axis placement."""
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    kwargs = dict(
+        max_len=16, d_model=16, n_heads=2, n_layers=1
+    )
+    toks = jnp.zeros((4, 16), jnp.int32)
+
+    def _mlp_bytes(tp):
+        model = transformer_lm.get_model(VOCAB, tensor_parallel=tp, **kwargs)
+        params = model.init(jax.random.PRNGKey(0), toks, train=True)["params"]
+        layers = capture.discover_layers(model, toks, train=True)
+        kfac = KFAC(damping=0.01, mesh=mesh, layers=layers)
+        state = kfac.init(params)
+        specs = kfac.state_shardings(state)
+        mlp = [n for n in layers if "ff1" in n or "ff2" in n]
+        sub = {
+            sec: {n: state[sec][n] for n in mlp}
+            for sec in ("factors", "eigen")
+        }
+        sub_specs = {
+            sec: {n: specs[sec][n] for n in mlp}
+            for sec in ("factors", "eigen")
+        }
+        return shardwise.state_bytes_local(sub, sub_specs, mesh)
+
+    sharded = _mlp_bytes(tp=2)
+    replicated = _mlp_bytes(tp=1)
+    assert sharded < replicated / 2, (sharded, replicated)
+
+
+def test_state_shardings_place_shard_stacks_on_tensor_axis():
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    assert batch_axes(mesh) == ("data", "fsdp")
+    kfac = KFAC(
+        damping=0.01, mesh=mesh,
+        layers=["b/ff1#c2", "b/ff2#r2", "b/out"],
+    )
+    params = {
+        "b": {
+            "ff1": {"kernel": jnp.zeros((16, 64)), "bias": jnp.zeros((64,))},
+            "ff2": {"kernel": jnp.zeros((64, 16))},
+            "out": {"kernel": jnp.zeros((16, 16)), "bias": jnp.zeros((16,))},
+        }
+    }
+    state = kfac.init(params)
+    specs = kfac.state_shardings(state)
+    assert specs["factors"]["b/ff1#c2"]["G"].spec == P("tensor")
+    assert specs["factors"]["b/ff1#c2"]["A"].spec == P()
+    assert specs["factors"]["b/ff2#r2"]["A"].spec == P("tensor")
+    assert specs["factors"]["b/ff2#r2"]["G"].spec == P()
+    assert specs["eigen"]["b/ff1#c2"]["cQG"].spec == P("tensor")
+    assert specs["eigen"]["b/ff2#r2"]["rQA"].spec == P("tensor")
+
+
+# ---------------------------------------------------------------------------
+# constructor refusals — one per new planner rule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kw,rule", [
+    (dict(precond_method="inverse"), "shard_lens_vs_inverse"),
+    (dict(diag_blocks=2), "shard_lens_vs_diag_blocks"),
+    (dict(factor_sharding="owner"), "shard_lens_vs_owner_sharding"),
+    (dict(eigh_chunks=2), "shard_lens_vs_chunks"),
+    (dict(solver="streaming"), "shard_lens_vs_streaming"),
+    (dict(service_devices=1), "service_vs_shard_lens"),
+])
+def test_shard_lens_constructor_refusals(kw, rule):
+    with pytest.raises(ValueError, match=rule):
+        KFAC(
+            damping=0.01, mesh=data_parallel_mesh(),
+            layers=["blk/ff1#c2"], **kw,
+        )
+
+
+@pytest.mark.parametrize("kw,rule", [
+    (dict(factor_sharding="owner"), "moe_vs_owner_sharding"),
+    (dict(factor_comm_freq=2), "moe_vs_deferred_comm"),
+    (dict(precond_method="inverse"), "shard_lens_vs_inverse"),
+    (dict(diag_blocks=2), "shard_lens_vs_diag_blocks"),
+    (dict(eigh_chunks=2), "shard_lens_vs_chunks"),
+    (dict(solver="streaming"), "shard_lens_vs_streaming"),
+    (dict(service_devices=1), "service_vs_shard_lens"),
+])
+def test_moe_constructor_refusals(kw, rule):
+    with pytest.raises(ValueError, match=rule):
+        KFAC(
+            damping=0.01, mesh=data_parallel_mesh(),
+            layers=["blk/moe#e4"], **kw,
+        )
+
+
+# ---------------------------------------------------------------------------
+# mesh validators
+# ---------------------------------------------------------------------------
+
+
+def test_data_fsdp_tensor_mesh_shape_and_order():
+    mesh = data_fsdp_tensor_mesh(2, 2)
+    assert tuple(mesh.axis_names) == ("data", "fsdp", "tensor")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2}
+
+
+def test_data_fsdp_tensor_mesh_refuses_bad_split():
+    with pytest.raises(ValueError):
+        data_fsdp_tensor_mesh(3, 2)  # 3*2 does not divide 8
