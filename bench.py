@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -51,6 +52,7 @@ _STAGE = ["startup"]
 _ARMS: dict = {}          # arm tag -> measurement dict (streamed as they land)
 _LM_ARMS: dict = {}       # transformer-arm measurements
 _META: dict = {}          # device/batch/... filled once backend is up
+_PROBE_LOG: list = []     # every probe attempt/backoff (BENCH_*.json carries it)
 _FINAL = threading.Event()
 
 
@@ -218,6 +220,13 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+# The flight recorder never touches jax, so it can record the backend
+# probe/retry saga itself (the rc=124 postmortems this PR exists for).
+from kfac_pytorch_tpu.observability.trace import (  # noqa: E402
+    configure_trace,
+    get_trace,
+)
+
 
 def _probe_backend_once(timeout_s: float):
     """Backend-init probe in a THROWAWAY subprocess with a hard timeout.
@@ -287,12 +296,23 @@ def _devices_with_retry():
                 f"{max(left, 0):.0f}/{budget:.0f}s budget left) ..."
             )
             ok, detail = _probe_backend_once(min(probe_timeout, max(left, 5.0)))
+            _PROBE_LOG.append({
+                "attempt": attempt, "kind": "probe", "ok": ok,
+                "detail": detail, "elapsed_s": round(_elapsed(), 1),
+            })
+            get_trace().event(
+                "bench_probe", attempt=attempt, ok=ok, detail=detail
+            )
         if ok:
             try:
                 _log("initializing backend (jax.devices()) ...")
                 return jax.devices()
             except Exception as e:  # RuntimeError / JaxRuntimeError
                 detail = f"{type(e).__name__}: {e}".splitlines()[0][:160]
+                _PROBE_LOG.append({
+                    "attempt": attempt, "kind": "init", "ok": False,
+                    "detail": detail, "elapsed_s": round(_elapsed(), 1),
+                })
         left = deadline - time.perf_counter()
         if left <= 0:
             break
@@ -300,6 +320,16 @@ def _devices_with_retry():
         _log(
             f"backend unavailable ({detail}); retrying in {sleep:.0f}s "
             f"({budget - left:.0f}/{budget:.0f}s used)"
+        )
+        _PROBE_LOG.append({
+            "attempt": attempt, "kind": "backoff", "detail": detail,
+            "backoff_s": round(sleep, 1), "elapsed_s": round(_elapsed(), 1),
+        })
+        get_trace().event(
+            "bench_backend_retry",
+            attempt=attempt,
+            detail=detail,
+            backoff_s=round(sleep, 1),
         )
         time.sleep(sleep)
         delay = min(delay * 2, 240.0)
@@ -309,6 +339,11 @@ def _devices_with_retry():
     )
     _META["backend_fallback"] = "cpu"
     _META["backend_fallback_reason"] = detail[:200]
+    _PROBE_LOG.append({
+        "kind": "fallback", "detail": detail[:200],
+        "elapsed_s": round(_elapsed(), 1),
+    })
+    get_trace().event("bench_backend_fallback", detail=detail[:200])
     jax.config.update("jax_platforms", "cpu")
     return jax.devices()
 
@@ -803,6 +838,51 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
             rec.update(kfac_amortized_ms=round(t_pipe * 1e3, 3),
                        kfac_img_per_s_chip=round(batch / t_pipe, 1),
                        overhead_pct=round(pipe_overhead, 2))
+
+    if kfac.plan is not None and "profile_shapes" in kfac_kwargs:
+        # Plan-vs-measured drift (planner/drift.py): recompute the cost
+        # model's predictions from the same facts the planner resolved
+        # against, ratio the run's measurements over them, and publish the
+        # kfac/plan_drift_* gauges. The wire check reuses the comm plane's
+        # own bucketing on the live state, so on a facts-faithful model it
+        # pins exactly 1.0; the refresh check calibrates MACs→ms off the
+        # f32 arm's measured eigh phase when that arm ran, else it
+        # self-calibrates (ratio 1.0 by construction, plumbing check only).
+        from kfac_pytorch_tpu.planner import Plan, detect_drift
+        from kfac_pytorch_tpu.planner.cost_model import refresh_cost
+        from kfac_pytorch_tpu.planner.drift import (
+            measured_wire_bytes_f32 as _measured_wire,
+        )
+
+        facts = kfac_kwargs["profile_shapes"]
+        wire = (rec.get("factor_comm") or {}).get("wire_bytes_f32_equiv")
+        if wire is None:
+            wire = _measured_wire(s_kfac.kfac_state)
+        refresh_delta_ms = (t_full - t_fac) * 1e3
+        if refresh_delta_ms <= 0:  # CPU timing noise can invert the delta
+            refresh_delta_ms = t_full * 1e3
+        f32_arm = _ARMS.get("f32") or {}
+        f32_eigh = (f32_arm.get("phase_breakdown_ms") or {}).get("eigh")
+        calib = None
+        if tag and f32_eigh and f32_eigh > 0:
+            # dense-MACs-per-ms from the f32 arm's eigh phase delta — the
+            # reference rate every other arm's refresh is judged against
+            calib = refresh_cost(facts, Plan()) / float(f32_eigh)
+        report = detect_drift(
+            facts, kfac.plan,
+            measured_wire_bytes_f32=int(wire),
+            measured_refresh_ms=refresh_delta_ms,
+            calibration_macs_per_ms=calib,
+            measured_state_bytes_local=rec.get("factor_state_bytes_local"),
+            factor_world=world,
+        )
+        rec["plan_drift"] = report.to_dict()
+        _log(
+            f"kfac{tag} plan drift ratios: "
+            + json.dumps(
+                {k: round(v, 4) for k, v in report.ratios.items()})
+            + (" (self-calibrated)" if report.self_calibrated else "")
+        )
     return rec
 
 
@@ -1313,6 +1393,17 @@ def main():
                        str(max(wall - 420.0, wall * 0.6)))
     )
 
+    # Flight recorder: one JSONL per phase (startup probe saga, then one
+    # file per arm — see _run_arm). configure_trace never touches jax, so
+    # the backend probe/retry transcript records even when init stalls.
+    trace_dir = os.environ.get("KFAC_BENCH_TRACE_DIR")
+    if not trace_dir:
+        trace_dir = tempfile.mkdtemp(prefix="kfac-bench-trace-")
+    os.makedirs(trace_dir, exist_ok=True)
+    configure_trace(os.path.join(trace_dir, "startup.jsonl"), host=0)
+    _META["trace_dir"] = trace_dir
+    _META["backend_probe_transcript"] = _PROBE_LOG
+
     devices = _devices_with_retry()
     _META.update(device=str(devices[0]), batch=batch, image_size=size)
     _log(f"device={devices[0]} batch={batch} image={size}")
@@ -1348,6 +1439,9 @@ def main():
             # publish the live record FIRST: a watchdog/SIGTERM snapshot
             # mid-arm keeps every timing that already landed
             _ARMS[key] = {}
+            trace_path = os.path.join(_META["trace_dir"], f"arm-{key}.jsonl")
+            configure_trace(trace_path, host=0)
+            _ARMS[key]["trace_jsonl"] = trace_path
             # reuse_sgd: True → the f32 arm's SGD baseline; a key string →
             # that arm's (same-batch, same-dtype) baseline; False → measure
             if reuse_sgd is True:
@@ -1474,6 +1568,10 @@ def main():
                 _ARMS[key] = {"tag": tag, "skipped": "arm_cutoff"}
             else:
                 _ARMS[key] = {"tag": tag}
+                trace_path = os.path.join(
+                    _META["trace_dir"], f"arm-{key}.jsonl")
+                configure_trace(trace_path, host=0)
+                _ARMS[key]["trace_jsonl"] = trace_path
                 try:
                     _resume_arm(_ARMS[key], arm_batch, size,
                                 fac_freq, kfac_freq)
@@ -1488,6 +1586,10 @@ def main():
                 _ARMS[key] = {"tag": tag, "skipped": "arm_cutoff"}
             else:
                 _ARMS[key] = {"tag": tag}
+                trace_path = os.path.join(
+                    _META["trace_dir"], f"arm-{key}.jsonl")
+                configure_trace(trace_path, host=0)
+                _ARMS[key]["trace_jsonl"] = trace_path
                 try:
                     _service_arm(_ARMS[key], arm_batch, size,
                                  fac_freq, kfac_freq)
@@ -1507,6 +1609,8 @@ def main():
         _run_arm(key, tag, arm_batch, dtype, kwargs, reuse)
 
     if not os.environ.get("KFAC_BENCH_SKIP_TRANSFORMER") and _elapsed() <= cutoff:
+        configure_trace(
+            os.path.join(_META["trace_dir"], "transformer.jsonl"), host=0)
         _transformer_bench(fac_freq, kfac_freq)
         _emit_lm_line()
 

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.observability.trace import get_trace
 from kfac_pytorch_tpu.parallel.assignment import (
     plan_factor_shards,
     plan_fingerprint,
@@ -184,6 +185,15 @@ def resize_owner_state(
 
     _REPLANS["count"] += 1
     get_telemetry().set_gauge("kfac/replan_count", _REPLANS["count"])
+    tr = get_trace()
+    if tr.enabled:
+        # fingerprint only computed when tracing — keeps the off path free
+        tr.event(
+            "replan",
+            plan_fingerprint=plan_fingerprint(new_plan),
+            old_world=int(old_world),
+            new_world=int(new_plan.world),
+        )
     return jax.device_put(new_state, kfac.state_shardings(new_state))
 
 

@@ -50,6 +50,7 @@ import jax
 from kfac_pytorch_tpu.elastic import replan as _replan
 from kfac_pytorch_tpu.elastic import state_io
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.observability.trace import get_trace
 
 _HEARTBEAT_DIR = "heartbeats"
 
@@ -132,6 +133,14 @@ class Supervisor:
         self.wait()
         t0 = time.monotonic()
         snap = state_io.snapshot_dir(self.save_dir, step)
+        snap_id = os.path.basename(snap)
+        tr = get_trace()
+        tr.event(
+            "snapshot_begin",
+            snapshot_id=snap_id,
+            step=int(step),
+            sync=bool(sync or not self.async_snapshots),
+        )
         # per-replica factor_local shards must be read while the live
         # arrays are addressable — device_get alone keeps only device 0's
         state, packed = state_io.pack_replica_local(
@@ -147,6 +156,9 @@ class Supervisor:
                         kfac=self.kfac, cadence=self.cadence, extra=extra,
                         packed_replica_local=packed,
                     )
+                    tr.event(
+                        "snapshot_commit", snapshot_id=snap_id, step=int(step)
+                    )
                     self._gc()
                 except Exception as e:  # noqa: BLE001 — surfaced via wait()
                     self._writer_error.append(f"{type(e).__name__}: {e}")
@@ -161,6 +173,7 @@ class Supervisor:
                 kfac=self.kfac, cadence=self.cadence, extra=extra,
                 packed_replica_local=packed,
             )
+            tr.event("snapshot_commit", snapshot_id=snap_id, step=int(step))
             self._gc()
         dur_ms = (time.monotonic() - t0) * 1e3
         self.snapshot_durations_ms.append(dur_ms)
@@ -176,6 +189,9 @@ class Supervisor:
             return
         snaps = state_io.list_snapshots(self.save_dir)
         for _, path in snaps[: -self.keep]:
+            get_trace().event(
+                "snapshot_gc", snapshot_id=os.path.basename(path)
+            )
             shutil.rmtree(path, ignore_errors=True)
 
     # -- the per-step hook --------------------------------------------
@@ -256,7 +272,11 @@ class Supervisor:
                 state = state.replace(kfac_state=rehomed)
             else:
                 state = rehomed
-        return state, manifest, int(manifest.get("step", step))
+        resume_step = int(manifest.get("step", step))
+        get_trace().event(
+            "resume", snapshot_id=os.path.basename(snap), step=resume_step
+        )
+        return state, manifest, resume_step
 
     # -- liveness -----------------------------------------------------
 
@@ -274,6 +294,7 @@ class Supervisor:
         with open(tmp, "w") as fh:
             json.dump({"t": time.time(), "step": int(step)}, fh)
         os.replace(tmp, path)
+        get_trace().event("heartbeat", step=int(step))
 
     def worker_beat(
         self, version: int = -1, min_interval_s: Optional[float] = None
@@ -307,6 +328,7 @@ class Supervisor:
                  "role": "curvature-worker"}, fh,
             )
         os.replace(tmp, path)
+        get_trace().event("worker_heartbeat", basis_version=int(version))
 
     def liveness(self) -> int:
         """Hosts whose last beat is within the liveness window."""

@@ -4,6 +4,8 @@
   process-wide registry (no-op when disabled).
 * :mod:`.export` — Prometheus textfile, JSONL stream, rank-aware summary.
 * :mod:`.diagnostics` — the in-graph K-FAC health-key vocabulary.
+* :mod:`.trace` — the flight recorder: per-host append-only structured
+  event log with cross-process correlation keys (no-op when disabled).
 
 The recompile detector (``RecompileMonitor``) lives in
 :mod:`kfac_pytorch_tpu.compile_cache` next to the compilation-cache setup
@@ -26,4 +28,9 @@ from kfac_pytorch_tpu.observability.telemetry import (  # noqa: F401
     Telemetry,
     configure,
     get_telemetry,
+)
+from kfac_pytorch_tpu.observability.trace import (  # noqa: F401
+    TraceRecorder,
+    configure_trace,
+    get_trace,
 )

@@ -14,9 +14,10 @@ Telemetry` snapshot:
   from), one record per metric, tagged with its kind.
 * :func:`summary_table` — the end-of-run human view: p50/p95/total per
   span plus counters, aggregated to rank 0 over a multi-host world via
-  ``process_allgather`` (SPMD loops emit the same span names everywhere,
-  so the packed stat arrays line up; a shape mismatch falls back to the
-  local table rather than deadlocking a rank).
+  ``process_allgather`` of the raw sample reservoirs, with percentiles
+  recomputed from the merged sample (SPMD loops emit the same span names
+  everywhere, so the packed arrays line up; a shape mismatch falls back
+  to the local table rather than deadlocking a rank).
 """
 
 from __future__ import annotations
@@ -92,33 +93,58 @@ def flush_jsonl(writer, telemetry: Telemetry, step: int) -> None:
         writer.add_scalar(f"span/{name}/count", s["count"], step)
 
 
-def _allgather_span_stats(names, spans):
-    """Stack every rank's (count,sum,p50,p95) rows for the agreed span-name
-    list; returns ``[n_proc, n_spans, 4]``."""
+def _allgather_span_samples(names, hists):
+    """Merge every rank's raw span-duration reservoirs.
+
+    Returns ``{name: merged sorted 1-D sample array}``. The reservoirs are
+    ragged across ranks (each rank observed its own count per span) while
+    ``process_allgather`` needs equal shapes, so: gather per-span counts
+    first, NaN-pad every rank's samples to the global max count, gather
+    once more, and slice each rank's real samples back out by its count.
+    Two small collectives; every rank reaches both.
+    """
     import numpy as np
     from jax.experimental import multihost_utils
 
-    local = np.asarray(
-        [
-            [
-                spans.get(n, {}).get(k, 0.0)
-                for k in ("count", "sum", "p50", "p95")
-            ]
-            for n in names
-        ],
-        dtype=np.float64,
+    counts = np.asarray(
+        [len(hists.get(n, ())) for n in names], dtype=np.int64
     )
-    return multihost_utils.process_allgather(local)
+    all_counts = multihost_utils.process_allgather(counts)  # [n_proc, n_spans]
+    cap = max(1, int(all_counts.max()))
+    local = np.full((len(names), cap), np.nan, dtype=np.float64)
+    for i, n in enumerate(names):
+        h = hists.get(n, ())
+        local[i, : len(h)] = h
+    gathered = multihost_utils.process_allgather(local)  # [n_proc, n_spans, cap]
+    merged = {}
+    for i, n in enumerate(names):
+        parts = [
+            gathered[r, i, : int(all_counts[r, i])]
+            for r in range(gathered.shape[0])
+        ]
+        merged[n] = np.sort(np.concatenate(parts))
+    return merged
+
+
+def _sample_percentile(samples, q: float) -> float:
+    """The same sorted-sample index rule as ``Telemetry.percentiles`` —
+    merged cross-rank percentiles stay comparable with local ones."""
+    n = len(samples)
+    if n == 0:
+        return 0.0
+    return float(samples[min(n - 1, int(q * n))])
 
 
 def summary_table(telemetry: Telemetry) -> str:
     """Format the end-of-run summary (call on every rank; print on rank 0).
 
-    Single-process: the local snapshot. Multi-process: span stats
-    aggregate over ranks (count summed, p50/p95 averaged — each rank's
-    percentile of its own stream, then meaned; honest for SPMD loops where
-    streams are iid) via one ``process_allgather``. Every rank must call
-    this (it is a collective in the multi-process case).
+    Single-process: the local snapshot. Multi-process: the raw span
+    reservoirs are allgathered and p50/p95 recomputed from the MERGED
+    sample (averaging per-rank percentiles — the old behavior — is
+    statistically wrong: the mean of per-rank medians is not the median,
+    and a straggler rank's tail vanishes into the average). Counts and
+    sums fall out of the same merged sample. Every rank must call this
+    (it is a collective in the multi-process case).
     """
     snap = telemetry.snapshot()
     names = sorted(snap["spans"])
@@ -134,15 +160,15 @@ def summary_table(telemetry: Telemetry) -> str:
         n_proc = 1
     if n_proc > 1 and names:
         try:
-            stats = _allgather_span_stats(names, snap["spans"])
+            samples = _allgather_span_samples(names, telemetry.hists)
             rows = {
                 n: (
-                    float(stats[:, i, 0].sum()),
-                    float(stats[:, i, 1].sum()),
-                    float(stats[:, i, 2].mean()),
-                    float(stats[:, i, 3].mean()),
+                    float(len(samples[n])),
+                    float(samples[n].sum()),
+                    _sample_percentile(samples[n], 0.5),
+                    _sample_percentile(samples[n], 0.95),
                 )
-                for i, n in enumerate(names)
+                for n in names
             }
         except Exception as e:  # name sets diverged across ranks
             rows["<local-only>"] = (0.0, 0.0, 0.0, 0.0)

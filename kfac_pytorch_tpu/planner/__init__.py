@@ -8,7 +8,9 @@ One production entry point over the levers PRs 2–6 landed individually:
 * :mod:`cost_model` — analytic per-lever cost/benefit from layer shape
   buckets, the LPT slot-cost tables, mesh shape, and bytes-on-wire;
 * :mod:`autotune` — optional warmup micro-autotune over 2–3 candidate
-  plans.
+  plans;
+* :mod:`drift` — post-run plan-vs-measured comparison publishing the
+  ``kfac/plan_drift_*`` ratio gauges.
 
 Consumed by ``KFAC(profile=...)`` (preconditioner.py), both example CLIs
 (``--profile``/``--autotune-steps``), bench.py's ``-prod`` arm, and the
@@ -27,6 +29,11 @@ from kfac_pytorch_tpu.planner.cost_model import (
     model_facts,
     resolve_profile,
 )
+from kfac_pytorch_tpu.planner.drift import (
+    DriftReport,
+    detect_drift,
+    measured_wire_bytes_f32,
+)
 from kfac_pytorch_tpu.planner.profiles import (
     PROFILES,
     Plan,
@@ -44,6 +51,7 @@ __all__ = [
     "AutotuneReport",
     "CostReport",
     "DEFAULT_AUTOTUNE_STEPS",
+    "DriftReport",
     "ModelFacts",
     "PROFILES",
     "Plan",
@@ -53,8 +61,10 @@ __all__ = [
     "autotune",
     "candidate_plans",
     "check_plan",
+    "detect_drift",
     "fit_plan",
     "log_plan",
+    "measured_wire_bytes_f32",
     "model_facts",
     "profile_names",
     "resolve_profile",

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.observability.trace import get_trace
 from kfac_pytorch_tpu.preconditioner import KFAC, KFACHParams
 
 #: Comm/compute pressure above which a staleness_budget > 0 cadence starts
@@ -328,6 +329,17 @@ class EigenRefreshCadence:
                     self._bootstrapped = True
                     self._last_refresh_step = step
                     self._reorth_count += 1
+                    get_trace().event(
+                        "cadence_reorth_fired",
+                        step=int(step),
+                        residual=self._stream_signal,
+                    )
+                else:
+                    get_trace().event(
+                        "cadence_reorth_skipped",
+                        step=int(step),
+                        residual=self._stream_signal,
+                    )
         elif k_eff == 1:
             flags["update_eigen"] = boundary
             if boundary:
@@ -364,6 +376,9 @@ class EigenRefreshCadence:
                     swap = False
                     self._swap_pending = True
                     self._swap_slip = 1
+                    get_trace().event(
+                        "cadence_swap_slipped", step=int(step), slip=1
+                    )
                 flags["eigen_chunk"] = (chunk, k_eff)
                 flags["swap_eigen"] = swap
                 if swap:
@@ -371,12 +386,22 @@ class EigenRefreshCadence:
             elif self._swap_pending:
                 if slipping and self._swap_slip < swap_allowance:
                     self._swap_slip += 1
+                    get_trace().event(
+                        "cadence_swap_slipped",
+                        step=int(step),
+                        slip=int(self._swap_slip),
+                    )
                 else:
                     # catch-up: the slipped swap lands as a bare promote
                     # (no chunk this step — update() has the matching
                     # bare-swap branch when staleness_budget > 0)
                     flags["swap_eigen"] = True
                     self._swap_pending = False
+                    get_trace().event(
+                        "cadence_swap_catchup",
+                        step=int(step),
+                        slip=int(self._swap_slip),
+                    )
                     self._swap_slip = 0
                     self._last_refresh_step = step
         comm = getattr(self.kfac, "factor_comm", None)
@@ -411,11 +436,21 @@ class EigenRefreshCadence:
                         # drops or the budget runs out — an existing
                         # (capture + flush) variant, no new program
                         flush = True
+                        get_trace().event(
+                            "cadence_flush_catchup",
+                            step=int(step),
+                            slip=int(self._flush_slip),
+                        )
                 elif due and slipping:
                     # withhold a due (non-forced) flush under pressure
                     flush = False
                     self._flush_owed = True
                     self._flush_slip = 1
+                    get_trace().event(
+                        "cadence_flush_slipped", step=int(step), slip=1
+                    )
+            if forced and flush:
+                get_trace().event("cadence_flush_forced", step=int(step))
             if flush:
                 self._flush_owed = False
                 self._flush_slip = 0

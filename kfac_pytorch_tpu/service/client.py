@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.observability.trace import get_trace
 from kfac_pytorch_tpu.ops import precondition as precond_ops
 from kfac_pytorch_tpu.service.mailbox import DeviceMailbox, HostMailbox
 from kfac_pytorch_tpu.service.worker import SCALARS_KEY, CurvatureWorker
@@ -100,6 +101,12 @@ class ServiceClient:
                 )
         self.installed_version = int(version)
         self.installed_step = int(step)
+        get_trace().event(
+            "basis_install",
+            basis_version=int(version),
+            step=int(step),
+            slip=int(slip),
+        )
         if self.cadence is not None and hasattr(
             self.cadence, "note_basis_installed"
         ):
@@ -190,13 +197,34 @@ class CurvatureService:
             deadline = self.published_step + 1 + self.staleness_budget
             if self.basis_box.latest_version() < self.published_version:
                 if step >= deadline:
-                    self._join_worker()
-                    self.basis_box.wait_for(
-                        self.published_version, timeout_s=self.timeout_s
+                    tel = get_telemetry()
+                    tr = get_trace()
+                    tel.inc("kfac/service_deadline_blocks")
+                    tr.event(
+                        "install_wait_begin",
+                        basis_version=int(self.published_version),
+                        step=int(step),
+                    )
+                    t0 = time.monotonic()
+                    with tel.span("trace/kfac/service_install_wait"):
+                        self._join_worker()
+                        self.basis_box.wait_for(
+                            self.published_version, timeout_s=self.timeout_s
+                        )
+                    tr.event(
+                        "install_wait_end",
+                        basis_version=int(self.published_version),
+                        step=int(step),
+                        wait_ms=(time.monotonic() - t0) * 1000.0,
                     )
             got = self.basis_box.latest()
             if got is not None and got[0] > self.client.installed_version:
                 version, payload, _meta = got
+                get_trace().event(
+                    "basis_consume",
+                    basis_version=int(version),
+                    step=int(step),
+                )
                 # slip: steps late vs the staleness-0 ideal of "installed
                 # before the step after its publish boundary"
                 slip = max(0, step - (self.published_step + 1))
@@ -218,6 +246,11 @@ class CurvatureService:
         t0 = time.monotonic()
         self.published_version += 1
         self.published_step = step
+        get_trace().event(
+            "factor_publish",
+            basis_version=int(self.published_version),
+            step=int(step),
+        )
         self.factors_box.publish(
             self.published_version,
             self._snapshot_factors(state),

@@ -42,6 +42,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from kfac_pytorch_tpu.observability.trace import get_trace
+
 _MANIFEST = "manifest.json"
 _PAYLOAD = "payload.npz"
 _VERSION_DIR = re.compile(r"^v-(\d{8})$")
@@ -76,6 +78,7 @@ class HostMailbox:
     """
 
     def __init__(self, root: str, name: str = "factors", keep: int = 2):
+        self.name = name
         self.root = os.path.join(os.path.abspath(root), name)
         self.keep = max(1, int(keep))
         os.makedirs(self.root, exist_ok=True)
@@ -124,6 +127,12 @@ class HostMailbox:
         with open(mtmp, "w") as fh:
             json.dump(manifest, fh)
         os.replace(mtmp, os.path.join(d, _MANIFEST))
+        get_trace().event(
+            "mailbox_publish",
+            box=self.name,
+            basis_version=int(version),
+            step=(meta or {}).get("step"),
+        )
         self._prune()
         return d
 
@@ -239,6 +248,12 @@ class DeviceMailbox:
             self._version = int(version)
             self._payload = payload
             self._meta = dict(meta or {})
+        get_trace().event(
+            "mailbox_publish",
+            box=self.name,
+            basis_version=int(version),
+            step=(meta or {}).get("step"),
+        )
 
     def latest_version(self) -> int:
         with self._lock:

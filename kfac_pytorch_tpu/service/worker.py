@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.observability.trace import get_trace
 from kfac_pytorch_tpu.parallel.sharded_eigh import replicated_eigen_update
 
 # Reserved payload key for run-level scalars riding a basis publish (the
@@ -137,12 +138,23 @@ class CurvatureWorker:
         version, facs, meta = got
         if version <= self.last_version:
             return None
+        tr = get_trace()
+        tr.event(
+            "worker_refresh_begin",
+            basis_version=int(version),
+            step=meta.get("step"),
+        )
         t0 = time.monotonic()
         payload = self.refresh(facs)
         # Block for completion before publishing: "complete version" must
         # mean the numbers exist, not that a computation was dispatched.
         payload = jax.device_get(payload)
         refresh_ms = (time.monotonic() - t0) * 1000.0
+        tr.event(
+            "worker_refresh_end",
+            basis_version=int(version),
+            refresh_ms=refresh_ms,
+        )
         self.basis.publish(version, payload, meta={**meta, "refresh_ms": refresh_ms})
         self.last_version = version
         tel.set_gauge("kfac/basis_version", version)

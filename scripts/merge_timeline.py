@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Merge N hosts' flight-recorder ``trace.jsonl`` files into one timeline.
+
+Each process records events against its own wall clock
+(``observability/trace.py``), and host clocks skew — so a naive global
+sort by ``ts_ns`` can show a basis installed before the worker refreshed
+it. The merge repairs causality from the correlation keys instead: every
+event that belongs to a known causal chain (``basis_version`` for the
+curvature-service publish→refresh→install pipeline, ``snapshot_id`` for
+the supervisor write→commit→gc/resume pipeline) gets a *phase rank*, the
+chain is sorted by (phase, ts), and a running max assigns each event an
+``adjusted_ts_ns`` that can never precede its causal predecessor — which
+is also what makes the staleness wait decomposition non-negative by
+construction. Events outside any chain keep their own timestamp.
+
+Report (``staleness_report``):
+
+* per-basis-version wait split — publish→refresh wait, refresh duration,
+  refresh→install wait, and the total publish→install staleness;
+* per-snapshot begin→commit latency;
+* per-(host, pid) heartbeat cadence with the largest observed gap, so a
+  host that went quiet is visible without grepping timestamps.
+
+Usage::
+
+    python scripts/merge_timeline.py trace-0.jsonl trace-1.jsonl \
+        [--out merged.jsonl] [--json report.json] [--heartbeat-gap-s 30]
+
+Importable: ``load_events`` / ``merge_events`` / ``staleness_report``
+(tests/test_trace.py drives them directly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Phase ranks inside the basis_version chain. Equal ranks are causally
+# concurrent (e.g. the trainer's deadline wait begins while the worker
+# refreshes); the mailbox_publish rank depends on which box it hit — the
+# trainer→worker factors box is upstream of the refresh, the
+# worker→trainer basis box downstream.
+_BASIS_PHASES = {
+    "factor_publish": 0,
+    "worker_refresh_begin": 2,
+    "install_wait_begin": 2,
+    "worker_refresh_end": 3,
+    "install_wait_end": 5,
+    "basis_consume": 5,
+    "basis_install": 6,
+}
+_MAILBOX_FACTORS_PHASE = 1
+_MAILBOX_BASIS_PHASE = 4
+
+_SNAPSHOT_PHASES = {
+    "snapshot_begin": 0,
+    "snapshot_commit": 1,
+    "snapshot_gc": 2,
+    "resume": 2,
+}
+
+
+def _chain_key(ev: Dict[str, Any]) -> Optional[Tuple[Tuple[str, Any], int]]:
+    """``((chain kind, correlation id), phase rank)`` or None."""
+    kind = ev.get("kind")
+    if kind == "mailbox_publish" and ev.get("basis_version") is not None:
+        phase = (
+            _MAILBOX_FACTORS_PHASE
+            if "factor" in str(ev.get("box", ""))
+            else _MAILBOX_BASIS_PHASE
+        )
+        return ("basis", ev["basis_version"]), phase
+    if kind in _BASIS_PHASES and ev.get("basis_version") is not None:
+        return ("basis", ev["basis_version"]), _BASIS_PHASES[kind]
+    if kind in _SNAPSHOT_PHASES and ev.get("snapshot_id") is not None:
+        return ("snapshot", ev["snapshot_id"]), _SNAPSHOT_PHASES[kind]
+    return None
+
+
+def load_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Read every trace file; tag each event with source file + line."""
+    events = []
+    for path in paths:
+        with open(path) as fh:
+            for seq, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn final line of a killed process
+                ev["_src"] = path
+                ev["_seq"] = seq
+                events.append(ev)
+    return events
+
+
+def merge_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Causally-ordered timeline with ``adjusted_ts_ns`` on every event."""
+    chains: Dict[Tuple[str, Any], List[Dict[str, Any]]] = {}
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        keyed = _chain_key(ev)
+        ev["_phase"] = None if keyed is None else keyed[1]
+        ev["adjusted_ts_ns"] = int(ev.get("ts_ns", 0))
+        if keyed is not None:
+            chains.setdefault(keyed[0], []).append(ev)
+        out.append(ev)
+    for chain in chains.values():
+        chain.sort(key=lambda e: (e["_phase"], e.get("ts_ns", 0)))
+        running = None
+        for ev in chain:
+            t = int(ev.get("ts_ns", 0))
+            running = t if running is None else max(running, t)
+            ev["adjusted_ts_ns"] = running
+    out.sort(
+        key=lambda e: (
+            e["adjusted_ts_ns"],
+            -1 if e["_phase"] is None else e["_phase"],
+            e.get("host", 0),
+            e.get("pid", 0),
+            e.get("_seq", 0),
+        )
+    )
+    return out
+
+
+def staleness_report(
+    merged: Sequence[Dict[str, Any]], heartbeat_gap_s: Optional[float] = None
+) -> Dict[str, Any]:
+    """Wait decomposition + snapshot latencies + heartbeat gaps."""
+    versions: Dict[int, Dict[str, int]] = {}
+    snapshots: Dict[str, Dict[str, int]] = {}
+    beats: Dict[Tuple[int, int], List[int]] = {}
+    for ev in merged:
+        kind = ev.get("kind")
+        t = int(ev.get("adjusted_ts_ns", ev.get("ts_ns", 0)))
+        v = ev.get("basis_version")
+        if v is not None:
+            slot = versions.setdefault(int(v), {})
+            if kind == "factor_publish" or (
+                kind == "mailbox_publish"
+                and "factor" in str(ev.get("box", ""))
+            ):
+                slot.setdefault("publish", t)
+            elif kind == "worker_refresh_begin":
+                slot.setdefault("refresh_begin", t)
+            elif kind == "worker_refresh_end":
+                slot["refresh_end"] = t
+            elif kind == "basis_install":
+                slot["install"] = t
+        sid = ev.get("snapshot_id")
+        if sid is not None:
+            snap = snapshots.setdefault(str(sid), {})
+            if kind == "snapshot_begin":
+                snap.setdefault("begin", t)
+            elif kind == "snapshot_commit":
+                snap["commit"] = t
+        if kind in ("heartbeat", "worker_heartbeat"):
+            beats.setdefault(
+                (ev.get("host", 0), ev.get("pid", 0)), []
+            ).append(t)
+
+    version_rows = {}
+    complete = 0
+    for v, s in sorted(versions.items()):
+        row: Dict[str, float] = {}
+        if "publish" in s and "refresh_begin" in s:
+            row["publish_to_refresh_ms"] = (
+                (s["refresh_begin"] - s["publish"]) / 1e6
+            )
+        if "refresh_begin" in s and "refresh_end" in s:
+            row["refresh_ms"] = (s["refresh_end"] - s["refresh_begin"]) / 1e6
+        if "refresh_end" in s and "install" in s:
+            row["refresh_to_install_ms"] = (
+                (s["install"] - s["refresh_end"]) / 1e6
+            )
+        if "publish" in s and "install" in s:
+            row["total_ms"] = (s["install"] - s["publish"]) / 1e6
+        row["complete"] = {
+            "publish", "refresh_begin", "refresh_end", "install"
+        } <= set(s)
+        complete += bool(row["complete"])
+        version_rows[v] = row
+
+    snapshot_rows = {
+        sid: {"write_ms": (s["commit"] - s["begin"]) / 1e6}
+        for sid, s in sorted(snapshots.items())
+        if "begin" in s and "commit" in s
+    }
+
+    heartbeat_rows = {}
+    for (host, pid), ts in sorted(beats.items()):
+        ts = sorted(ts)
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        max_gap_s = (max(gaps) / 1e9) if gaps else 0.0
+        row = {"beats": len(ts), "max_gap_s": max_gap_s}
+        if heartbeat_gap_s is not None:
+            row["gap_exceeded"] = max_gap_s > float(heartbeat_gap_s)
+        heartbeat_rows[f"host{host}/pid{pid}"] = row
+
+    return {
+        "versions": version_rows,
+        "complete_chains": complete,
+        "snapshots": snapshot_rows,
+        "heartbeats": heartbeat_rows,
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="+", help="per-process trace.jsonl files")
+    ap.add_argument("--out", help="write the merged timeline JSONL here")
+    ap.add_argument("--json", help="write the staleness report JSON here")
+    ap.add_argument(
+        "--heartbeat-gap-s", type=float, default=None,
+        help="flag (host,pid) streams whose largest beat gap exceeds this",
+    )
+    args = ap.parse_args(argv)
+
+    merged = merge_events(load_events(args.traces))
+    report = staleness_report(merged, heartbeat_gap_s=args.heartbeat_gap_s)
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            for ev in merged:
+                fh.write(json.dumps(ev) + "\n")
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+
+    print(
+        f"merge_timeline: {len(merged)} events from {len(args.traces)} "
+        f"file(s); {len(report['versions'])} basis version(s) "
+        f"({report['complete_chains']} complete), "
+        f"{len(report['snapshots'])} snapshot(s), "
+        f"{len(report['heartbeats'])} heartbeat stream(s)"
+    )
+    for v, row in report["versions"].items():
+        parts = [
+            f"{k}={row[k]:.3f}"
+            for k in (
+                "publish_to_refresh_ms", "refresh_ms",
+                "refresh_to_install_ms", "total_ms",
+            )
+            if k in row
+        ]
+        print(f"  basis v{v}: {' '.join(parts) or '(incomplete chain)'}")
+    for sid, row in report["snapshots"].items():
+        print(f"  snapshot {sid}: write_ms={row['write_ms']:.3f}")
+    for who, row in report["heartbeats"].items():
+        flag = " GAP-EXCEEDED" if row.get("gap_exceeded") else ""
+        print(
+            f"  heartbeat {who}: beats={row['beats']} "
+            f"max_gap_s={row['max_gap_s']:.3f}{flag}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

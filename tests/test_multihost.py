@@ -44,6 +44,12 @@ assert jax.process_count() == 2, jax.process_count()
 assert jax.process_index() == pid
 assert jax.device_count() == 4 and len(jax.local_devices()) == 2
 
+# flight recorder: one per-process trace file; the parent merges both and
+# asserts cross-process causal ordering (scripts/merge_timeline.py)
+from kfac_pytorch_tpu.observability.trace import configure_trace
+trace_path = os.path.join(os.environ["KFAC_SNAPDIR"], f"trace-{pid}.jsonl")
+configure_trace(trace_path, host=pid)
+
 import numpy as np
 import jax.numpy as jnp
 from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh, put_global_batch
@@ -396,6 +402,8 @@ out["owner3d_resume_bitwise"] = bool(all(
 ))
 out["owner3d_resume_param_sum"] = _psum(rB.params)
 
+out["trace_path"] = trace_path
+configure_trace(None)
 print("RESULT " + json.dumps(out), flush=True)
 """
 
@@ -653,6 +661,57 @@ def test_owner3d_deferred_snapshot_resume_lossless(world):
     assert r0["owner3d_param_sum"] == r1["owner3d_param_sum"]
     assert r0["owner3d_resume_param_sum"] == r0["owner3d_param_sum"]
     assert r1["owner3d_resume_param_sum"] == r1["owner3d_param_sum"]
+
+
+def test_flight_recorder_merged_timeline(world):
+    """Both processes' flight-recorder files merge into one causally
+    consistent timeline: the spare-host service chain threads host 0's
+    factor publish through host 1's worker refresh back to BOTH hosts'
+    installs, in basis-version order and with a non-negative wait
+    decomposition — despite the two processes stamping independent
+    clocks."""
+    import importlib.util
+
+    r0, r1 = world
+    spec = importlib.util.spec_from_file_location(
+        "merge_timeline",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "scripts", "merge_timeline.py"),
+    )
+    mt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mt)
+
+    merged = mt.merge_events(
+        mt.load_events([r0["trace_path"], r1["trace_path"]]))
+    report = mt.staleness_report(merged)
+
+    # the svc section publishes versions 1 and 2; both chains complete
+    assert {1, 2} <= set(report["versions"])
+    for v in (1, 2):
+        row = report["versions"][v]
+        assert row["complete"], (v, row)
+        assert all(row[k] >= 0.0 for k in (
+            "publish_to_refresh_ms", "refresh_ms",
+            "refresh_to_install_ms", "total_ms")), (v, row)
+
+    # version 2 is published exactly once (the resume tenant reuses only
+    # version 1), so its merged ordering is strict: host 0's factors-box
+    # publish, then host 1's refresh, then installs on both hosts
+    v2 = [e for e in merged if e.get("basis_version") == 2]
+    pub = [e for e in v2 if e["kind"] == "mailbox_publish"
+           and "factor" in str(e.get("box", ""))]
+    ref = [e for e in v2 if e["kind"] == "worker_refresh_begin"]
+    inst = [e for e in v2 if e["kind"] == "basis_install"]
+    assert pub and ref and len(inst) == 2  # both trainer processes install
+    assert {e["host"] for e in pub} == {0}
+    assert {e["host"] for e in ref} == {1}
+    assert {e["host"] for e in inst} == {0, 1}
+    assert merged.index(pub[0]) < merged.index(ref[0])
+    assert all(merged.index(ref[0]) < merged.index(e) for e in inst)
+
+    # collective snapshots left begin→commit pairs with sane latencies
+    assert report["snapshots"]
+    assert all(s["write_ms"] >= 0.0 for s in report["snapshots"].values())
 
 
 def test_stream_snapshot_resume_across_processes(world):

@@ -72,6 +72,18 @@ def test_pallas_interpret_lint_clean():
     assert "OK" in res.stdout
 
 
+def test_trace_events_lint_clean():
+    """Every flight-recorder event kind emitted in the package must appear
+    in docs/OBSERVABILITY.md's trace-event registry, and vice versa
+    (scripts/check_trace_events.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_trace_events.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_collective_count_check():
     """The compiled capture step must carry ≤ bucket-count factor
     all-reduces over the plain step — per-leaf collectives sneaking back in

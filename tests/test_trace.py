@@ -1,0 +1,430 @@
+"""Flight recorder (observability/trace.py), timeline merge
+(scripts/merge_timeline.py), and plan-vs-measured drift (planner/drift.py).
+
+Contracts pinned here:
+
+* off-by-default null-singleton discipline — and the big one: tracing
+  on/off leaves the lowered train-step HLO **bit-identical** (events are
+  host-side only);
+* event schema (ts_ns/host/pid/kind + fields, numpy coercion);
+* correlation threading: a real CPU curvature-service run produces a
+  merged timeline whose publish→refresh→install chain is complete per
+  basis version with a non-negative wait decomposition;
+* causal repair: with worker clocks skewed a naive ts sort inverts the
+  chain, the merge does not;
+* heartbeat-gap detection;
+* staleness-deadline observability (`kfac/service_deadline_blocks` +
+  `trace/kfac/service_install_wait` + install_wait events);
+* drift ratios pin exactly 1.0 on CPU where the prediction is exact by
+  construction (shared bucketing primitive / self-calibration).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfac_pytorch_tpu import KFAC
+from kfac_pytorch_tpu.models.layers import KFACDense
+from kfac_pytorch_tpu.observability.telemetry import Telemetry, get_telemetry
+from kfac_pytorch_tpu.observability.trace import (
+    TraceRecorder,
+    configure_trace,
+    get_trace,
+)
+from kfac_pytorch_tpu.planner import Plan, detect_drift, model_facts
+from kfac_pytorch_tpu.planner.drift import measured_wire_bytes_f32
+from kfac_pytorch_tpu.service import CurvatureService
+from kfac_pytorch_tpu.training.step import TrainState, make_sgd, make_train_step
+
+from test_preconditioner import _dense_params, _stats_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_merge_timeline():
+    spec = importlib.util.spec_from_file_location(
+        "merge_timeline", os.path.join(REPO, "scripts", "merge_timeline.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _restore_trace():
+    """Every test leaves the process-global recorder as it found it: off."""
+    yield
+    configure_trace(None)
+
+
+def _read_events(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# -- recorder core ------------------------------------------------------
+
+
+def test_null_singleton_default():
+    tr = get_trace()
+    assert tr.enabled is False and tr.path is None
+    tr.event("anything", basis_version=1)  # no-op, no file, no error
+    tr.flush()
+    tr.close()
+    # all call sites share ONE instance — the off path allocates nothing
+    assert get_trace() is tr
+
+
+def test_configure_roundtrip_and_schema(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = configure_trace(path, host=3)
+    assert tr is get_trace() and tr.enabled and tr.path == path
+    tr.event("snapshot_begin", snapshot_id="v-0004", step=4, sync=True)
+    tr.event("basis_install", basis_version=np.int64(7),
+             slip=jnp.asarray(1, jnp.int32))  # numpy/jax scalars coerce
+    configure_trace(None)
+    assert get_trace().enabled is False
+
+    evs = _read_events(path)
+    assert [e["kind"] for e in evs] == ["snapshot_begin", "basis_install"]
+    for e in evs:
+        assert e["host"] == 3 and e["pid"] == os.getpid()
+        assert isinstance(e["ts_ns"], int) and e["ts_ns"] > 0
+    assert evs[0]["snapshot_id"] == "v-0004" and evs[0]["sync"] is True
+    assert evs[1]["basis_version"] == 7 and evs[1]["slip"] == 1
+    # events after close are silently dropped, not errors
+    tr.event("basis_install", basis_version=8)
+    assert len(_read_events(path)) == 2
+
+
+def test_recorder_thread_safety(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = TraceRecorder(path, host=0)
+    threads = [
+        threading.Thread(
+            target=lambda i=i: [
+                tr.event("heartbeat", step=i * 100 + j) for j in range(50)
+            ]
+        )
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+    evs = _read_events(path)  # every line parses — no torn interleaving
+    assert len(evs) == 200
+    assert {e["step"] for e in evs} == {
+        i * 100 + j for i in range(4) for j in range(50)
+    }
+
+
+# -- compiled-step identity ---------------------------------------------
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(KFACDense(16, name="fc1")(x))
+        return KFACDense(10, name="fc2")(x)
+
+
+def _lowered_text(kfac):
+    model = _MLP()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(8, 4, 3).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=8))
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        kfac_state=kfac.init(params),
+    )
+    fn = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    return fn.lower(
+        state, (x, y), jnp.float32(0.1), jnp.float32(0.01),
+        update_factors=True, update_eigen=True,
+    ).as_text()
+
+
+def test_tracing_off_vs_on_hlo_identical(tmp_path):
+    """Events are host-side only: enabling the flight recorder must leave
+    the lowered train-step program bit-identical — the same zero-cost
+    contract telemetry.span() pins."""
+    base = _lowered_text(KFAC(damping=0.01))
+    configure_trace(str(tmp_path / "trace.jsonl"), host=0)
+    assert _lowered_text(KFAC(damping=0.01)) == base
+
+
+# -- correlation threading through a real CPU service run ----------------
+
+
+def test_service_chain_merged_timeline(tmp_path):
+    """A single-host service_devices=1 run, traced, merges into a timeline
+    whose publish→refresh→install chain is COMPLETE for every consumed
+    basis version, with a non-negative wait decomposition."""
+    configure_trace(str(tmp_path / "trace.jsonl"), host=0)
+    params = _dense_params(np.random.RandomState(0), [6, 5, 4])
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+                service_devices=1)
+    state = kfac.init(params)
+    svc = CurvatureService(kfac, worker_devices=(), async_worker=False,
+                           staleness_budget=0)
+    for step in range(5):
+        state = svc.before_step(step, state)
+        a, g, grads = _stats_for(params, np.random.RandomState(100 + step))
+        _, state = kfac.update(
+            grads, state, a_contribs=a, g_factor_stats=g,
+            lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+            update_factors=True, update_eigen=False,
+        )
+        svc.after_step(step, state)
+    assert svc.client.installed_version == 2  # boundaries 0/2 consumed
+    configure_trace(None)
+
+    mt = _load_merge_timeline()
+    merged = mt.merge_events(mt.load_events([str(tmp_path / "trace.jsonl")]))
+    kinds = {e["kind"] for e in merged}
+    assert {"factor_publish", "mailbox_publish", "worker_refresh_begin",
+            "worker_refresh_end", "basis_consume", "basis_install"} <= kinds
+
+    report = mt.staleness_report(merged)
+    assert report["complete_chains"] >= 2
+    for v in (1, 2):
+        row = report["versions"][v]
+        assert row["complete"], row
+        for key in ("publish_to_refresh_ms", "refresh_ms",
+                    "refresh_to_install_ms", "total_ms"):
+            assert row[key] >= 0.0, (v, key, row)
+        # decomposition sums to the total
+        assert row["total_ms"] == pytest.approx(
+            row["publish_to_refresh_ms"] + row["refresh_ms"]
+            + row["refresh_to_install_ms"], abs=1e-6)
+        # merged ORDER matches causality for each version
+        chain = [e["kind"] for e in merged
+                 if e.get("basis_version") == v and e["kind"] in (
+                     "factor_publish", "worker_refresh_begin",
+                     "worker_refresh_end", "basis_install")]
+        assert chain == ["factor_publish", "worker_refresh_begin",
+                         "worker_refresh_end", "basis_install"]
+
+
+def test_service_deadline_block_observability(tmp_path):
+    """When the trainer hits the staleness deadline it must leave a trail:
+    the `kfac/service_deadline_blocks` counter, one
+    `trace/kfac/service_install_wait` span sample, and bracketing
+    install_wait_begin/end events with a non-negative wait_ms."""
+    configure_trace(str(tmp_path / "trace.jsonl"), host=0)
+    tel = get_telemetry()
+    was_enabled = tel.enabled
+    tel.enabled = True
+    try:
+        params = _dense_params(np.random.RandomState(0), [5, 4])
+        kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=2,
+                    service_devices=1)
+        a, g, grads = _stats_for(params, np.random.RandomState(1))
+        _, state = kfac.update(
+            grads, kfac.init(params), a_contribs=a, g_factor_stats=g,
+            lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+            update_factors=True, update_eigen=False,
+        )
+        svc = CurvatureService(kfac, worker_devices=(), async_worker=True,
+                               staleness_budget=0)
+        blocks0 = tel.counters.get("kfac/service_deadline_blocks", 0.0)
+        orig_step = svc.worker.step
+
+        def slow_step(timeout_s=None):
+            time.sleep(0.2)  # basis NOT ready when the deadline arrives
+            return orig_step(timeout_s=timeout_s)
+
+        svc.worker.step = slow_step
+        svc.after_step(0, state)   # publish v1, kick the (slow) worker
+        state = svc.before_step(1, state)  # deadline step: must block
+        assert svc.client.installed_version == 1
+        assert tel.counters["kfac/service_deadline_blocks"] == blocks0 + 1
+        assert len(tel.hists["trace/kfac/service_install_wait"]) >= 1
+    finally:
+        tel.enabled = was_enabled
+    configure_trace(None)
+
+    evs = _read_events(str(tmp_path / "trace.jsonl"))
+    begin = [e for e in evs if e["kind"] == "install_wait_begin"]
+    end = [e for e in evs if e["kind"] == "install_wait_end"]
+    assert len(begin) == 1 and len(end) == 1
+    assert begin[0]["basis_version"] == end[0]["basis_version"] == 1
+    assert end[0]["wait_ms"] >= 0.0
+
+
+# -- causal merge on synthetic skewed clocks -----------------------------
+
+
+def _ev(ts_ns, host, kind, **fields):
+    return {"ts_ns": ts_ns, "host": host, "pid": 10 + host, "kind": kind,
+            **fields}
+
+
+def test_merge_repairs_skewed_worker_clock():
+    """Worker host clock 1 ms behind the trainer: a naive ts sort shows
+    the refresh (and even the install's payload publish) BEFORE the factor
+    publish; the merge restores phase order and keeps every wait
+    non-negative."""
+    base = 1_000_000_000_000
+    events = [
+        _ev(base + 100, 0, "factor_publish", basis_version=1, step=0),
+        _ev(base + 200, 0, "mailbox_publish", box="job0-factors",
+            basis_version=1, step=0),
+        # skewed: these ts_ns values precede the publish above
+        _ev(base - 900_000, 1, "worker_refresh_begin", basis_version=1,
+            step=0),
+        _ev(base - 850_000, 1, "worker_refresh_end", basis_version=1,
+            refresh_ms=0.05),
+        _ev(base - 840_000, 1, "mailbox_publish", box="job0-basis",
+            basis_version=1),
+        _ev(base + 300_000, 0, "basis_consume", basis_version=1, step=1),
+        _ev(base + 400_000, 0, "basis_install", basis_version=1, step=1,
+            slip=0),
+    ]
+    mt = _load_merge_timeline()
+    naive = sorted(events, key=lambda e: e["ts_ns"])
+    assert naive[0]["kind"] == "worker_refresh_begin"  # the lie
+
+    merged = mt.merge_events(events)
+    order = [e["kind"] for e in merged]
+    assert order.index("factor_publish") < order.index("worker_refresh_begin")
+    assert (order.index("worker_refresh_begin")
+            < order.index("worker_refresh_end"))
+    assert order.index("worker_refresh_end") < order.index("basis_install")
+    # adjusted timestamps are monotone along the chain
+    adj = [e["adjusted_ts_ns"] for e in merged]
+    assert adj == sorted(adj)
+
+    row = mt.staleness_report(merged)["versions"][1]
+    assert row["complete"]
+    assert all(row[k] >= 0.0 for k in ("publish_to_refresh_ms", "refresh_ms",
+                                       "refresh_to_install_ms", "total_ms"))
+
+
+def test_merge_tolerates_torn_line_and_heartbeat_gaps(tmp_path):
+    """A SIGKILLed process leaves a torn final line — load_events skips it.
+    The report flags (host,pid) heartbeat streams whose largest gap
+    exceeds the threshold."""
+    s = 1_000_000_000  # 1s in ns
+    p = tmp_path / "t0.jsonl"
+    lines = [json.dumps(_ev(i * s, 0, "heartbeat", step=i))
+             for i in (0, 1, 2, 33)]  # 31s gap at the end
+    p.write_text("\n".join(lines) + "\n" + '{"ts_ns": 123, "ki')  # torn
+    q = tmp_path / "t1.jsonl"
+    q.write_text("\n".join(
+        json.dumps(_ev(i * s, 1, "worker_heartbeat", basis_version=i))
+        for i in (0, 1, 2, 3)) + "\n")
+
+    mt = _load_merge_timeline()
+    merged = mt.merge_events(mt.load_events([str(p), str(q)]))
+    assert len(merged) == 8  # torn line dropped, everything else kept
+    report = mt.staleness_report(merged, heartbeat_gap_s=10.0)
+    hb = report["heartbeats"]
+    assert hb["host0/pid10"]["beats"] == 4
+    assert hb["host0/pid10"]["max_gap_s"] == pytest.approx(31.0)
+    assert hb["host0/pid10"]["gap_exceeded"] is True
+    assert hb["host1/pid11"]["gap_exceeded"] is False
+
+
+def test_merge_timeline_cli(tmp_path, capsys):
+    mt = _load_merge_timeline()
+    p = tmp_path / "trace.jsonl"
+    p.write_text("\n".join([
+        json.dumps(_ev(1_000, 0, "factor_publish", basis_version=1, step=0)),
+        json.dumps(_ev(2_000, 0, "worker_refresh_begin", basis_version=1)),
+        json.dumps(_ev(3_000, 0, "worker_refresh_end", basis_version=1)),
+        json.dumps(_ev(4_000, 0, "basis_install", basis_version=1, step=1)),
+    ]) + "\n")
+    out = tmp_path / "merged.jsonl"
+    rep = tmp_path / "report.json"
+    assert mt.main([str(p), "--out", str(out), "--json", str(rep)]) == 0
+    assert "1 basis version(s) (1 complete)" in capsys.readouterr().out
+    assert len(_read_events(str(out))) == 4
+    report = json.loads(rep.read_text())
+    assert report["versions"]["1"]["complete"] is True
+
+
+# -- plan-vs-measured drift ----------------------------------------------
+
+
+def test_drift_ratios_exact_on_cpu():
+    """CPU pin: predictions exact by construction → every ratio is 1.0.
+    Wire: measured runs the SAME bucketing primitive over the live factor
+    shapes the prediction derives from ModelFacts. Refresh: no calibration
+    supplied → self-calibrates, ratio 1.0, flagged."""
+    params = _dense_params(np.random.RandomState(0), [8, 6, 4])
+    facts = model_facts(params)
+    kfac = KFAC(damping=0.01)
+    a, g, grads = _stats_for(params, np.random.RandomState(1))
+    _, state = kfac.update(
+        grads, kfac.init(params), a_contribs=a, g_factor_stats=g,
+        lr=jnp.float32(0.1), damping=jnp.float32(0.01),
+        update_factors=True, update_eigen=False,
+    )
+    tel = Telemetry(enabled=True)
+    report = detect_drift(
+        facts, Plan(),
+        measured_wire_bytes_f32=measured_wire_bytes_f32(state),
+        measured_refresh_ms=7.5,
+        telemetry=tel,
+    )
+    assert report.ratios["wire_bytes"] == pytest.approx(1.0)
+    assert report.self_calibrated
+    assert report.ratios["refresh_rate"] == pytest.approx(1.0)
+    assert tel.gauges["kfac/plan_drift_wire_bytes"] == pytest.approx(1.0)
+    assert tel.gauges["kfac/plan_drift_refresh_rate"] == pytest.approx(1.0)
+    # round-trippable record (bench stores it in the arm JSON)
+    d = report.to_dict()
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_drift_external_calibration_and_owner_bytes():
+    """With an external MACs→ms calibration the refresh ratio is a real
+    signal (2x slower run → ratio 2.0, not flagged self-calibrated); the
+    owner-bytes check engages only under owner sharding with world > 1 and
+    pins 1.0 when measured equals the shard plan's own accounting."""
+    from kfac_pytorch_tpu.parallel.assignment import (
+        plan_factor_shards,
+        shard_plan_bytes,
+    )
+    from kfac_pytorch_tpu.planner.cost_model import _rank_fn_for, refresh_cost
+
+    params = _dense_params(np.random.RandomState(0), [8, 6, 4])
+    facts = model_facts(params)
+    plan = Plan(factor_sharding="owner")
+    macs = refresh_cost(facts, plan)
+    calib = macs / 5.0  # "the model predicts 5 ms"
+    shard = plan_factor_shards(facts.shapes, 2, diag_a=set(facts.diag_a))
+    pred_local = shard_plan_bytes(shard, rank_fn=_rank_fn_for(plan))[
+        "total_buffer_local"]
+
+    tel = Telemetry(enabled=True)
+    report = detect_drift(
+        facts, plan,
+        measured_refresh_ms=10.0,  # ran 2x slower than predicted
+        calibration_macs_per_ms=calib,
+        measured_state_bytes_local=int(pred_local),
+        factor_world=2,
+        telemetry=tel,
+    )
+    assert not report.self_calibrated
+    assert report.ratios["refresh_rate"] == pytest.approx(2.0)
+    assert report.ratios["owner_bytes"] == pytest.approx(1.0)
+    assert tel.gauges["kfac/plan_drift_refresh_rate"] == pytest.approx(2.0)
+    assert tel.gauges["kfac/plan_drift_owner_bytes"] == pytest.approx(1.0)
