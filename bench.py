@@ -91,10 +91,19 @@ def _emit(error: str | None = None, partial: bool = False) -> None:
                 best = _best_overhead()
                 prod = _ARMS.get("production") or {}
                 over = _ARMS.get("overlap") or {}
+                fused = _ARMS.get("fused_apply") or {}
                 strm = _ARMS.get("stream") or {}
                 svc = _ARMS.get("service") or {}
                 headline = over.get(
                     "overhead_pct", prod.get("overhead_pct", best))
+                # the fused-apply arm is the production profile with the
+                # Pallas apply pinned — a pure program-body swap of the same
+                # schedule, so it takes the headline whenever it measures
+                # faster than the dense-apply production point
+                if fused.get("overhead_pct") is not None and (
+                    headline is None or fused["overhead_pct"] < headline
+                ):
+                    headline = fused["overhead_pct"]
                 # the streaming arm takes the headline when its drift-gated
                 # schedule measured AND wins — the solver is a strict
                 # operating-point improvement, not a numerics trade
@@ -453,6 +462,25 @@ def _staleness_p95(kfac, kfac_freq):
     return round(float(np.percentile(ages, 95)), 2)
 
 
+def _wire_f32_equiv(fc):
+    """f32-equivalent bytes of the comm plane's last exchange.
+
+    bf16/f32 wires divide by itemsize; the int8 wire's bytes include the
+    per-block scales, so the element count comes from the bucket plan whose
+    exact accounting produced ``last_wire_bytes`` (comm.quant_wire_bytes)."""
+    from kfac_pytorch_tpu.parallel.comm import quant_wire_bytes
+
+    if fc.last_wire_bytes is None:
+        return None
+    if getattr(fc, "quantized", False):
+        for plan in fc._plans.values():
+            sizes = [b.size for b in plan]
+            if quant_wire_bytes(sizes) == fc.last_wire_bytes:
+                return sum(sizes) * 4
+        return None
+    return fc.last_wire_bytes // fc.comm_dtype.itemsize * 4
+
+
 def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
                  kfac_kwargs=None, sgd_time=None, rec=None):
     """Measure SGD + the three K-FAC step variants for one configuration.
@@ -542,7 +570,15 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         _log(f"kfac{tag} resolved plan: {kfac.plan.describe()}"
              + (f" (dropped: {list(kfac.plan_dropped)})"
                 if kfac.plan_dropped else ""))
-    kfac_step = make_train_step(model, tx, kfac, train_kwargs={"train": True})
+    # Read the RESOLVED apply kernel off the preconditioner (a production
+    # plan pins pallas on TPU without the arm spelling it); when fused, the
+    # train step also declares sgd_hyper — the bench's tx is exactly
+    # make_sgd(0.9, 5e-5) — so the separate optax pass fuses away too.
+    rec["apply_kernel"] = getattr(kfac, "apply_kernel", "dense")
+    kfac_step = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True},
+        sgd_hyper=(0.9, 5e-5) if rec["apply_kernel"] == "pallas" else None,
+    )
 
     # Compiled-memory report for the factor-update step — the arm's peak
     # footprint (the b128 lever is memory-bound, not FLOP-bound). Streamed
@@ -556,11 +592,7 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         # above traced the captured variant), so the arm record carries the
         # per-capture-step factor bytes/collectives next to its timings
         fc = kfac.factor_comm
-        f32_equiv = (
-            fc.last_wire_bytes // fc.comm_dtype.itemsize * 4
-            if fc.last_wire_bytes is not None
-            else None
-        )
+        f32_equiv = _wire_f32_equiv(fc)
         rec["factor_comm"] = {
             "dtype": str(fc.comm_dtype),
             "freq": fc.comm_freq,
@@ -569,6 +601,13 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
             "wire_bytes_f32_equiv": f32_equiv,
             "collectives": fc.last_collectives,
         }
+        if getattr(fc, "quantized", False) and f32_equiv:
+            # the -wire8 headline: measured bytes vs the bf16 wire carrying
+            # the same buckets (2 bytes/element) — ≈ 0.51 (codes + 1.6%
+            # block-scale overhead)
+            rec["factor_comm"]["wire_vs_bf16_ratio"] = round(
+                fc.last_wire_bytes / (f32_equiv / 4 * 2), 4
+            )
         if not fc.active:
             rec["factor_comm"]["note"] = (
                 "single device: plane inert, factor stats local and exact"
@@ -605,15 +644,32 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         # full step just compiled), not the capture step's — refresh the
         # wire fields recorded above
         fc = kfac.factor_comm
+        f32_equiv = _wire_f32_equiv(fc)
         rec["factor_comm"].update(
             wire_bytes_per_exchange=fc.last_wire_bytes,
-            wire_bytes_f32_equiv=(
-                fc.last_wire_bytes // fc.comm_dtype.itemsize * 4
-                if fc.last_wire_bytes is not None
-                else None
-            ),
+            wire_bytes_f32_equiv=f32_equiv,
             collectives=fc.last_collectives,
         )
+        if getattr(fc, "quantized", False) and f32_equiv:
+            # the capture-variant trace above had no flush plan yet — the
+            # ratio only exists once the flush step traced the buckets
+            rec["factor_comm"]["wire_vs_bf16_ratio"] = round(
+                fc.last_wire_bytes / (f32_equiv / 4 * 2), 4
+            )
+        if getattr(fc, "quantized", False) and "wire_error" in (
+            s_kfac.kfac_state or {}
+        ):
+            from kfac_pytorch_tpu.parallel.comm import (
+                publish_wire_quant_error,
+            )
+
+            # error-feedback residual norm after the warm-up flushes — a
+            # norm that trends up across bench rounds means the int8 wire
+            # is fighting the factor dynamics (gauge
+            # kfac/wire_quant_error_norm)
+            rec["factor_comm"]["wire_quant_error_norm"] = round(
+                publish_wire_quant_error(s_kfac.kfac_state["wire_error"]), 6
+            )
     t_plain, sd_plain, win_plain, s_kfac = _timeit(
         run_kfac(False, False), s_kfac, label=f"kfac{tag} precond-only")
     rec.update(kfac_precond_ms=round(t_plain * 1e3, 3),
@@ -654,6 +710,9 @@ def _measure_arm(batch, size, fac_freq, kfac_freq, dtype=None, tag="",
         kfac_img_per_s_chip=round(batch / t_amort, 1),
         overhead_pct=round(overhead_pct, 2),
         overhead_alt_schedule_f200_e2000_pct=round(overhead_alt_pct, 2),
+        # the every-step precondition+update tax over plain SGD — the
+        # number the fused apply kernel attacks; compare -fused vs -prod
+        precond_apply_ms=round((t_plain - t_sgd) * 1e3, 3),
         # per-phase device cost by step-variant deltas (the step is ONE
         # compiled program, so phases can't be timed in isolation; the SGD
         # arm isolates the every-step precondition tax —
@@ -1477,6 +1536,16 @@ def main():
         # against the <25% target (ROADMAP item 3). Reuses the f32 SGD
         # baseline (same model dtype and batch).
         ("production", "-prod", batch, None, dict(profile="production"), True),
+        # -fused: the production profile with the fused Pallas apply pinned
+        # — per-layer eigenbasis rotate→damped-divide→back-rotate, the
+        # KL-clip partials, and the momentum+weight-decay SGD update in one
+        # VMEM-resident pass per shape group (ops/apply_kernels.py; the
+        # step also declares sgd_hyper, deleting the separate optax pass —
+        # scripts/check_apply_hlo.py pins the program shape). Read
+        # precond_apply_ms against -prod's; its overhead_pct takes the
+        # headline when it wins.
+        ("fused_apply", "-fused", batch, None,
+         dict(profile="production", apply_kernel="pallas"), True),
         # -overlap: the production profile with the overlap plane pinned on —
         # factor-bucket reductions fused into the gradient stream, the
         # chunked refresh hidden behind backprop (eigh_chunks pinned so the
@@ -1514,6 +1583,14 @@ def main():
         # factor wire bytes/collectives from the plane's trace-time gauges
         ("factor_comm", "-comm", batch, None,
          dict(factor_comm_dtype="bf16", factor_comm_freq=fac_freq), True),
+        # -wire8: the block-scaled int8 factor wire on the same deferred
+        # bucketed exchange as -comm — codes + per-256-block f32 scales ≈
+        # 0.51x the bf16 bytes (factor_comm.wire_vs_bf16_ratio), stochastic
+        # rounding + per-replica error feedback carried in state
+        # (wire_quant_error_norm). Compare wire_bytes_per_exchange against
+        # the -comm arm's at the same bucket plan.
+        ("wire8", "-wire8", batch, None,
+         dict(factor_comm_dtype="int8", factor_comm_freq=fac_freq), True),
         # -shard: owner-sharded factor state (DP-KFAC) composed with the
         # bf16 wire and the pipelined refresh — curvature memory and factor
         # wire both scale O(model/devices); read factor_state_bytes_local

@@ -155,10 +155,13 @@ def parse_args(argv=None):
                         "DistributedOptimizer, pytorch_cifar10_resnet.py:"
                         "190-195); None = exact f32 reduction")
     p.add_argument("--factor-comm-dtype", default="f32",
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="wire dtype of the bucketed K-FAC factor-statistics "
                         "exchange (parallel/comm.py); f32 = bitwise parity "
-                        "with the per-layer exchange")
+                        "with the per-layer exchange; int8 = block-scaled "
+                        "codes + error feedback at 0.51x the bf16 bytes "
+                        "(requires --factor-comm-freq > 1; docs/PERF.md "
+                        "'Sub-bf16 wire')")
     p.add_argument("--factor-comm-freq", type=int, default=1,
                    help="allreduce factor statistics every N capture steps "
                         "instead of every one (merged running averages, "
@@ -197,6 +200,15 @@ def parse_args(argv=None):
                         "patch-covariance Pallas kernel (no im2col patch "
                         "tensor, enables large batches; docs/PERF.md), dense "
                         "= im2col oracle, auto = pallas on TPU else dense")
+    p.add_argument("--apply-kernel", default="auto",
+                   choices=["auto", "pallas", "dense"],
+                   help="preconditioned-update apply path: pallas = one "
+                        "fused VMEM kernel per shape group (rotate + damped "
+                        "scale + back-rotate + KL-clip partial, plus the "
+                        "momentum/weight-decay update when the step declares "
+                        "sgd_hyper; docs/PERF.md 'Fused apply'), dense = "
+                        "einsum chain + optax oracle, auto = pallas on TPU "
+                        "else dense")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 conv/matmul compute (params + K-FAC factor "
                         "math stay f32)")
@@ -365,6 +377,7 @@ def main(argv=None):
                 track_diagnostics=args.kfac_diagnostics,
                 eigh_chunks=args.eigh_chunks,
                 factor_kernel=args.factor_kernel,
+                apply_kernel=args.apply_kernel,
                 factor_comm_dtype=args.factor_comm_dtype,
                 factor_comm_freq=args.factor_comm_freq,
                 solver=args.solver,
@@ -417,6 +430,7 @@ def main(argv=None):
                     mesh=mesh if args.grad_comm_dtype else None,
                     grad_comm_dtype=(jnp.bfloat16
                                      if args.grad_comm_dtype == "bf16" else None),
+                    sgd_hyper=(args.momentum, args.wd),
                 )
 
             warm = put_global_batch(
@@ -487,6 +501,9 @@ def main(argv=None):
         stats_all_microbatches=args.stats_all_microbatches,
         mesh=mesh if args.grad_comm_dtype else None,
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
+        # tx IS make_sgd(momentum, wd): the declaration lets a pallas
+        # apply_kernel fuse the optimizer pass; inert under dense
+        sgd_hyper=(args.momentum, args.wd) if kfac is not None else None,
     )
     eval_step = make_masked_eval_step(
         model, label_smoothing=args.label_smoothing, eval_kwargs={"train": False}

@@ -156,11 +156,14 @@ def parse_args(argv=None):
                         "DistributedOptimizer); pure-DP only "
                         "(--seq-parallel 1)")
     p.add_argument("--factor-comm-dtype", default="f32",
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="wire dtype of the bucketed K-FAC factor-statistics "
                         "exchange (parallel/comm.py); pure-DP only "
                         "(--seq-parallel 1); f32 = bitwise parity with the "
-                        "per-layer exchange")
+                        "per-layer exchange; int8 = block-scaled codes + "
+                        "error feedback at 0.51x the bf16 bytes (requires "
+                        "--factor-comm-freq > 1; docs/PERF.md 'Sub-bf16 "
+                        "wire')")
     p.add_argument("--factor-comm-freq", type=int, default=1,
                    help="allreduce factor statistics every N capture steps "
                         "(merged running averages, always flushed before an "
@@ -175,6 +178,14 @@ def parse_args(argv=None):
                         "(--seq-parallel 1; --tensor-parallel composes). "
                         "Diagonal-A embedding factors shard as [vocab] "
                         "vector slots, so --kfac-embedding composes too")
+    p.add_argument("--apply-kernel", default="auto",
+                   choices=["auto", "pallas", "dense"],
+                   help="preconditioned-update apply path: pallas = one "
+                        "fused VMEM kernel per shape group (rotate + damped "
+                        "scale + back-rotate + KL-clip partial, plus the "
+                        "momentum/weight-decay update; docs/PERF.md 'Fused "
+                        "apply'), dense = einsum chain + optax oracle, auto "
+                        "= pallas on TPU else dense")
     p.add_argument("--solver", default="eigh",
                    choices=["eigh", "rsvd", "streaming"],
                    help="curvature eigensolver: eigh = full (dense) "
@@ -296,6 +307,7 @@ def main(argv=None):
 
     cli_plan = planner.Plan(
         eigh_chunks=args.eigh_chunks,
+        apply_kernel=args.apply_kernel,
         factor_comm_dtype=args.factor_comm_dtype,
         factor_comm_freq=args.factor_comm_freq,
         solver=args.solver,
@@ -459,6 +471,7 @@ def main(argv=None):
                 mesh=mesh if devices.size > 1 else None,
                 track_diagnostics=args.kfac_diagnostics,
                 eigh_chunks=args.eigh_chunks,
+                apply_kernel=args.apply_kernel,
                 factor_comm_dtype=args.factor_comm_dtype,
                 factor_comm_freq=args.factor_comm_freq,
                 solver=args.solver,
@@ -511,6 +524,7 @@ def main(argv=None):
                         jnp.bfloat16 if args.grad_comm_dtype == "bf16"
                         else None
                     ),
+                    sgd_hyper=(args.momentum, args.wd),
                 )
 
             warm_rng = np.random.RandomState(args.seed)
@@ -586,6 +600,9 @@ def main(argv=None):
         model, tx, kfac, train_kwargs={"train": True}, grad_clip=args.grad_clip,
         mesh=mesh if args.grad_comm_dtype else None,
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
+        # tx IS make_sgd(momentum, wd): the declaration lets a pallas
+        # apply_kernel fuse the optimizer pass; inert under dense
+        sgd_hyper=(args.momentum, args.wd) if kfac is not None else None,
     )
     eval_fn = make_eval_step(model, eval_kwargs={"train": False})
 

@@ -106,9 +106,12 @@ def parse_args(argv=None):
                         "after each --kfac-update-freq boundary; 1 = "
                         "monolithic, bit-exact (docs/PERF.md)")
     p.add_argument("--factor-comm-dtype", default="f32",
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="wire dtype of the bucketed K-FAC factor exchange "
-                        "(multi-device only; f32 = bitwise parity)")
+                        "(multi-device only; f32 = bitwise parity; int8 = "
+                        "block-scaled codes + error feedback at 0.51x the "
+                        "bf16 bytes, requires --factor-comm-freq > 1; "
+                        "docs/PERF.md 'Sub-bf16 wire')")
     p.add_argument("--factor-comm-freq", type=int, default=1,
                    help="allreduce factor statistics every N capture steps "
                         "(multi-device only; 1 = per-step, exact)")
@@ -118,6 +121,13 @@ def parse_args(argv=None):
                         "O(model/devices) factor memory; embedding diag-A "
                         "factors shard as [vocab] vector slots, so "
                         "--kfac-embedding composes (docs/PERF.md)")
+    p.add_argument("--apply-kernel", default="auto",
+                   choices=["auto", "pallas", "dense"],
+                   help="preconditioned-update apply path: pallas = one "
+                        "fused VMEM kernel per shape group, incl. the "
+                        "momentum/weight-decay update (docs/PERF.md 'Fused "
+                        "apply'); dense = einsum chain + optax oracle; auto "
+                        "= pallas on TPU else dense")
     p.add_argument("--solver", default="eigh",
                    choices=["eigh", "rsvd", "streaming"],
                    help="curvature eigensolver (rsvd: randomized truncated "
@@ -205,6 +215,7 @@ def main(argv=None):
             # matrix's reasons instead of ad-hoc SystemExits
             cli_plan = planner.Plan(
                 eigh_chunks=args.eigh_chunks,
+                apply_kernel=args.apply_kernel,
                 factor_comm_dtype=args.factor_comm_dtype,
                 factor_comm_freq=args.factor_comm_freq,
                 solver=args.solver,
@@ -254,6 +265,7 @@ def main(argv=None):
                 kfac_update_freq=args.kfac_update_freq,
                 mesh=mesh,
                 eigh_chunks=args.eigh_chunks,
+                apply_kernel=args.apply_kernel,
                 factor_comm_dtype=args.factor_comm_dtype,
                 factor_comm_freq=args.factor_comm_freq,
                 solver=args.solver,
@@ -328,6 +340,9 @@ def main(argv=None):
         model, tx, kfac, grad_clip=args.clip,
         mesh=mesh if args.grad_comm_dtype else None,
         grad_comm_dtype=jnp.bfloat16 if args.grad_comm_dtype == "bf16" else None,
+        # tx IS make_sgd(momentum, wd): the declaration lets a pallas
+        # apply_kernel fuse the optimizer pass; inert under dense
+        sgd_hyper=(args.momentum, args.wd) if kfac is not None else None,
     )
     eval_step = make_lm_eval_step(model)
 

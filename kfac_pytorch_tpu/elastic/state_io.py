@@ -63,6 +63,8 @@ KFAC_STATE_KEYS: Dict[str, str] = {
                            "(eigh_chunks > 1, owner mode)",
     "factor_local": "per-replica local factor accumulators between deferred "
                     "flushes (owner mode, factor_comm_freq > 1)",
+    "wire_error": "per-replica int8-wire error-feedback residuals, one flat "
+                  "f32 buffer per comm bucket (factor_comm_dtype='int8')",
     "factor_sync_age": "capture steps since the last cross-replica factor "
                        "merge (int32 scalar, 0 = globally synced)",
     "spectrum_mass": "trace fraction the truncated bases captured at the "
@@ -76,6 +78,14 @@ KFAC_STATE_KEYS: Dict[str, str] = {
                        "slipped swap (staleness_budget > 0)",
     "diagnostics": "in-graph health diagnostics (track_diagnostics=True)",
 }
+
+
+#: State keys holding per-REPLICA data inside replicated-spec arrays —
+#: device copies genuinely differ, so snapshots must pack every device's
+#: shard (see :func:`pack_replica_local`). ``factor_local``: deferred
+#: factor accumulators; ``wire_error``: int8-wire error-feedback residuals
+#: (each replica carries its own quantization residue between flushes).
+_REPLICA_LOCAL_KEYS: Tuple[str, ...] = ("factor_local", "wire_error")
 
 
 class SnapshotError(RuntimeError):
@@ -195,10 +205,11 @@ def _with_kfac_state(state: Any, kstate: Dict[str, Any]) -> Any:
 
 
 def pack_replica_local(state: Any, mesh: Any = None) -> Tuple[Any, bool]:
-    """Stack ``factor_local``'s per-replica shards into a ``(world, ...)``
-    leading axis; returns ``(state, packed)``.
+    """Stack every :data:`_REPLICA_LOCAL_KEYS` entry's per-replica shards
+    into a ``(world, ...)`` leading axis; returns ``(state, packed)``.
 
-    ``factor_local`` is per-REPLICA data riding in a replicated-spec array:
+    ``factor_local`` (and the int8 wire's ``wire_error`` residuals, which
+    ride the same way) is per-REPLICA data in a replicated-spec array:
     each device accumulates its own batch shard's statistics between
     deferred flushes, so the device copies genuinely differ and a plain
     ``jax.device_get`` silently keeps only device 0's accumulator —
@@ -218,9 +229,12 @@ def pack_replica_local(state: Any, mesh: Any = None) -> Tuple[Any, bool]:
     lossless off flush boundaries across hosts too.
     """
     kstate = kfac_state_of(state)
-    if kstate is None or "factor_local" not in kstate:
+    if kstate is None:
         return state, False
-    leaves = jax.tree_util.tree_leaves(kstate["factor_local"])
+    keys = [k for k in _REPLICA_LOCAL_KEYS if k in kstate]
+    if not keys:
+        return state, False
+    leaves = jax.tree_util.tree_leaves({k: kstate[k] for k in keys})
     if not leaves or not hasattr(leaves[0], "addressable_shards"):
         return state, False  # already host-side: per-replica info is gone
     devs = (
@@ -251,21 +265,28 @@ def pack_replica_local(state: Any, mesh: Any = None) -> Tuple[Any, bool]:
             )
             return np.stack([np.asarray(s.data) for s in shards])
 
-    local = jax.tree_util.tree_map(pack, kstate["factor_local"])
-    return _with_kfac_state(state, {**kstate, "factor_local": local}), True
+    packed = {k: jax.tree_util.tree_map(pack, kstate[k]) for k in keys}
+    return _with_kfac_state(state, {**kstate, **packed}), True
 
 
 def stack_local_template(target: Any, world: int) -> Any:
-    """Give ``target``'s ``factor_local`` leaves the packed ``(world, ...)``
-    shape so orbax restores a packed snapshot into a matching template."""
+    """Give ``target``'s replica-local leaves (:data:`_REPLICA_LOCAL_KEYS`)
+    the packed ``(world, ...)`` shape so orbax restores a packed snapshot
+    into a matching template."""
     kstate = kfac_state_of(target)
-    if kstate is None or "factor_local" not in kstate:
+    if kstate is None:
         return target
-    local = jax.tree_util.tree_map(
-        lambda x: np.zeros((int(world),) + tuple(np.shape(x)), x.dtype),
-        kstate["factor_local"],
-    )
-    return _with_kfac_state(target, {**kstate, "factor_local": local})
+    keys = [k for k in _REPLICA_LOCAL_KEYS if k in kstate]
+    if not keys:
+        return target
+    stacked = {
+        k: jax.tree_util.tree_map(
+            lambda x: np.zeros((int(world),) + tuple(np.shape(x)), x.dtype),
+            kstate[k],
+        )
+        for k in keys
+    }
+    return _with_kfac_state(target, {**kstate, **stacked})
 
 
 def unpack_replica_local(state: Any, mesh: Any) -> Any:
@@ -276,7 +297,10 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
     process puts only the rows of its own addressable devices (the restored
     packed array is host-replicated, so every host sees all rows)."""
     kstate = kfac_state_of(state)
-    if kstate is None or "factor_local" not in kstate:
+    if kstate is None:
+        return state
+    keys = [k for k in _REPLICA_LOCAL_KEYS if k in kstate]
+    if not keys:
         return state
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -288,7 +312,7 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
         x = np.asarray(jax.device_get(x))
         if x.shape[0] != len(devs):
             raise SnapshotError(
-                f"packed factor_local world {x.shape[0]} != mesh size "
+                f"packed replica-local world {x.shape[0]} != mesh size "
                 f"{len(devs)} — resize replans drop deferred accumulators"
             )
         bufs = [jax.device_put(x[i], d) for i, d in enumerate(devs)
@@ -297,8 +321,8 @@ def unpack_replica_local(state: Any, mesh: Any) -> Any:
             x.shape[1:], spec, bufs
         )
 
-    local = jax.tree_util.tree_map(unpack, kstate["factor_local"])
-    return _with_kfac_state(state, {**kstate, "factor_local": local})
+    unpacked = {k: jax.tree_util.tree_map(unpack, kstate[k]) for k in keys}
+    return _with_kfac_state(state, {**kstate, **unpacked})
 
 
 def snapshot_dir(directory: str, step: int) -> str:
@@ -331,8 +355,9 @@ def save_snapshot(
     manifest = build_manifest(state, kfac=kfac, cadence=cadence, extra=extra)
     manifest["packed_replica_local"] = bool(packed_replica_local)
     if packed_replica_local:
+        kst = kfac_state_of(state) or {}
         rows = jax.tree_util.tree_leaves(
-            (kfac_state_of(state) or {}).get("factor_local", {})
+            {k: kst[k] for k in _REPLICA_LOCAL_KEYS if k in kst}
         )
         if rows:
             # rows = mesh size (every device's replica accumulator), which

@@ -56,6 +56,72 @@ from kfac_pytorch_tpu.parallel.assignment import (
 PyTree = Any
 
 _F32 = np.dtype(np.float32)
+_INT8 = np.dtype(np.int8)
+
+# Block-scaled int8 wire (KFAC(factor_comm_dtype="int8")): each bucket is
+# quantized per contiguous 256-element block against its own max-abs scale.
+# 256 keeps the scale overhead at 4/256 = 1.6% of the payload (int8 wire ≈
+# 0.51x the bf16 bytes) while bounding the dynamic range one scale must
+# cover — A and G statistics of different layers sharing a bucket can sit
+# orders of magnitude apart, and a single per-bucket scale would crush the
+# small ones to zero codes.
+_QUANT_BLOCK = 256
+# Stochastic rounding follows the repo's deterministic-PRNG convention
+# (ops/rsvd.py _SKETCH_SEED): one fixed, dated base seed, discriminated by
+# fold_in — here per flush step and per bucket — so reruns are bit-exact
+# and no per-device randomness exists (each replica rounds its OWN payload;
+# the shared key stream is deterministic, the data differ).
+_QUANT_SEED = 21070653  # arxiv 2107.06533 (SPD-KFAC), the wire-lever lineage
+
+
+def quantize_bucket(
+    buf: jnp.ndarray, key: jax.Array
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Block-scaled stochastic int8 quantization of one flat f32 bucket.
+
+    Returns ``(codes [nblocks, 256] int8, scales [nblocks, 1] f32)``. The
+    rounding is ``floor(x/scale + u)`` with ``u ~ U[0, 1)`` — unbiased
+    (``E[q]·scale = x``), which is what lets the EMA-linearity argument that
+    justified the bf16 wire extend down to 8 bits: the quantization noise
+    is zero-mean per step and the error-feedback accumulator re-injects
+    whatever a single step did round away. An all-zero block quantizes
+    against scale 1.0 to zero codes (exact).
+    """
+    n = int(buf.shape[0])
+    pad = (-n) % _QUANT_BLOCK
+    x = jnp.pad(buf, (0, pad)) if pad else buf
+    blocks = x.reshape(-1, _QUANT_BLOCK)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    u = jax.random.uniform(key, blocks.shape, jnp.float32)
+    codes = jnp.clip(jnp.floor(blocks / scale + u), -127.0, 127.0)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_bucket(
+    codes: jnp.ndarray, scale: jnp.ndarray, n: int
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_bucket`: f32 ``[n]`` bucket payload."""
+    return (codes.astype(jnp.float32) * scale).reshape(-1)[:n]
+
+
+def quant_wire_bytes(sizes: List[int]) -> int:
+    """Exact int8 wire bytes for bucket payload sizes: 1 byte per element
+    plus 4 bytes per 256-element block scale."""
+    return sum(s + (-(-s // _QUANT_BLOCK)) * 4 for s in sizes)
+
+
+def publish_wire_quant_error(wire_error: Dict[str, jnp.ndarray]) -> float:
+    """Host-side: global L2 norm of the error-feedback residuals onto the
+    ``kfac/wire_quant_error_norm`` gauge (docs/OBSERVABILITY.md). A norm
+    that trends upward instead of hovering means the int8 wire is
+    systematically fighting the factor dynamics — widen the wire."""
+    total = 0.0
+    for v in wire_error.values():
+        total += float(jnp.sum(jnp.square(jnp.asarray(v, jnp.float32))))
+    norm = float(np.sqrt(total))
+    get_telemetry().set_gauge("kfac/wire_quant_error_norm", norm)
+    return norm
 
 
 def flatten_buckets(
@@ -241,6 +307,15 @@ class FactorComm:
         )
 
     @property
+    def quantized(self) -> bool:
+        """Sub-bf16 wire: the bucket payload crosses as block-scaled int8
+        codes + f32 scales, with per-replica error feedback. Only legal on
+        the deferred path (``KFAC.__init__`` refuses int8 at
+        ``factor_comm_freq=1`` — the per-step contribution exchange has no
+        state slot to carry the residual in)."""
+        return self.comm_dtype == _INT8
+
+    @property
     def overlap_mode(self) -> int:
         """The kfac/overlap_mode gauge value: 0 = off (serial), 1 = fused
         psum stream, 2 = ppermute ring fallback."""
@@ -258,7 +333,15 @@ class FactorComm:
                 [leaf.shape for leaf in leaves], self.max_bucket_elems
             )
             self._plans[key] = plan
-        wire = sum(b.size for b in plan) * self.comm_dtype.itemsize
+        sizes = [b.size for b in plan]
+        if self.quantized:
+            # exact accounting: int8 codes plus the per-block f32 scales
+            # (planner/cost_model.plan_wire_bytes mirrors this formula, and
+            # planner/drift.py normalizes measurements back to f32-equivalent
+            # before comparing, so plan_drift_wire_bytes stays 1.0)
+            wire = quant_wire_bytes(sizes)
+        else:
+            wire = sum(sizes) * self.comm_dtype.itemsize
         tel = get_telemetry()
         tel.set_gauge("kfac/factor_wire_bytes", wire)
         tel.set_gauge("kfac/factor_collectives", len(plan))
@@ -277,6 +360,12 @@ class FactorComm:
         wire downcast) is ``ops.factors.merge_running_avg_buckets``.
         """
         axis = axis_name or self.axis_name
+        if self.quantized:
+            raise ValueError(
+                "int8 factor wire routes through FactorComm.flush(..., "
+                "wire_error=...) only — the plain bucketed pmean cannot "
+                "reduce int8 codes"
+            )
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         with get_telemetry().span("trace/kfac/factor_comm"):
             plan = self._plan_for(leaves)
@@ -335,7 +424,80 @@ class FactorComm:
         tree = self.allreduce(tree, axis_name)
         return capture.split_factor_stat_tree(tree)
 
-    def flush(self, facs: PyTree) -> PyTree:
+    def wire_error_init(self, facs: PyTree) -> Dict[str, jnp.ndarray]:
+        """Zero error-feedback residuals, one f32 buffer per wire bucket.
+
+        Keyed ``"b<i>"`` by bucket index — the bucket plan is a pure
+        function of the stat-tree leaf shapes, so the keys are stable
+        across restarts and the buffers snapshot/restore like any other
+        state (they are REPLICA-LOCAL data: ``elastic/state_io.py`` packs
+        them per replica exactly like the deferred ``factor_local`` tree).
+        """
+        leaves, _ = jax.tree_util.tree_flatten(facs)
+        plan = plan_factor_buckets(
+            [leaf.shape for leaf in leaves], self.max_bucket_elems
+        )
+        return {
+            f"b{i}": jnp.zeros((b.size,), jnp.float32)
+            for i, b in enumerate(plan)
+        }
+
+    def _merge_quantized(
+        self,
+        tree: PyTree,
+        wire_error: Dict[str, jnp.ndarray],
+        seed: jnp.ndarray,
+    ) -> Tuple[PyTree, Dict[str, jnp.ndarray]]:
+        """Int8 bucket merge with error feedback (inside the shard_map).
+
+        Per bucket: fold the carried residual into the payload, quantize
+        (block-scaled, stochastically rounded), put ONLY the int8 codes and
+        the per-block f32 scales on the wire (``lax.all_gather`` — a psum
+        would have to widen the codes before they ever left the device),
+        and dequantize+average locally. The new residual is this replica's
+        payload minus its own dequantized codes — what the OTHER replicas
+        just received wrong from us and will be compensated for at the next
+        flush (error feedback, per-replica divergent state).
+        """
+        axis = self.axis_name
+        world = self._axis_world(axis)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        tel = get_telemetry()
+        with tel.span("trace/kfac/factor_comm"):
+            plan = self._plan_for(leaves)
+            bufs = flatten_buckets(leaves, plan)
+            base = jax.random.fold_in(
+                jax.random.PRNGKey(_QUANT_SEED), seed
+            )
+            merged: List[jnp.ndarray] = []
+            new_error: Dict[str, jnp.ndarray] = {}
+            for i, buf in enumerate(bufs):
+                n = int(buf.shape[0])
+                payload = buf.astype(jnp.float32) + wire_error[f"b{i}"]
+                codes, scale = quantize_bucket(
+                    payload, jax.random.fold_in(base, i)
+                )
+                new_error[f"b{i}"] = payload - dequantize_bucket(
+                    codes, scale, n
+                )
+                all_codes = lax.all_gather(codes, axis)
+                all_scale = lax.all_gather(scale, axis)
+                mean = (
+                    jnp.sum(
+                        all_codes.astype(jnp.float32) * all_scale, axis=0
+                    )
+                    / world
+                )
+                merged.append(mean.reshape(-1)[:n].astype(buf.dtype))
+            leaves = unflatten_buckets(merged, plan, leaves)
+        return jax.tree_util.tree_unflatten(treedef, leaves), new_error
+
+    def flush(
+        self,
+        facs: PyTree,
+        wire_error: Optional[Dict[str, jnp.ndarray]] = None,
+        seed: Optional[jnp.ndarray] = None,
+    ):
         """Merge the per-replica factor running averages (deferred mode).
 
         Runs in the GSPMD region of the jitted step: between flushes the
@@ -344,12 +506,32 @@ class FactorComm:
         replicated arrays execute per-device, no collective resyncs them),
         so a ``shard_map`` with replicated specs hands each device its own
         copy and one bucketed pmean produces the uniform-weight merge.
+
+        With an int8 wire the caller supplies the error-feedback residuals
+        (``wire_error``, from KFAC state) and the deterministic rounding
+        discriminator (``seed``, the step counter); the return value is then
+        ``(facs, new_wire_error)`` instead of ``facs``.
         """
         if not self.defer:
             raise ValueError(
                 "FactorComm.flush() requires deferred factor communication "
                 "(factor_comm_freq > 1 with a multi-device KFAC mesh)"
             )
+        if self.quantized:
+            if wire_error is None:
+                raise ValueError(
+                    "int8 factor wire needs the error-feedback residuals: "
+                    "flush(facs, wire_error=state['wire_error'], seed=step)"
+                )
+            fn = partial(
+                compat.shard_map,
+                mesh=self.mesh,
+                in_specs=(P(), P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(self._merge_quantized)
+            step = jnp.asarray(0 if seed is None else seed, jnp.int32)
+            return fn(facs, wire_error, step)
         fn = partial(
             compat.shard_map,
             mesh=self.mesh,

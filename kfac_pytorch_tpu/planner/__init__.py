@@ -27,6 +27,7 @@ from kfac_pytorch_tpu.planner.cost_model import (
     CostReport,
     ModelFacts,
     model_facts,
+    plan_wire_bytes,
     resolve_profile,
 )
 from kfac_pytorch_tpu.planner.drift import (
@@ -66,6 +67,7 @@ __all__ = [
     "log_plan",
     "measured_wire_bytes_f32",
     "model_facts",
+    "plan_wire_bytes",
     "profile_names",
     "resolve_profile",
     "violations",
@@ -89,6 +91,14 @@ def log_plan(plan: Plan, dropped=(), telemetry=None) -> None:
     tel.set_gauge(
         "kfac/plan_factor_comm_bf16",
         1.0 if plan.factor_comm_dtype == "bf16" else 0.0,
+    )
+    tel.set_gauge(
+        "kfac/plan_factor_comm_int8",
+        1.0 if plan.factor_comm_dtype == "int8" else 0.0,
+    )
+    tel.set_gauge(
+        "kfac/plan_apply_kernel_pallas",
+        1.0 if plan.apply_kernel == "pallas" else 0.0,
     )
     tel.set_gauge("kfac/plan_factor_comm_freq", float(plan.factor_comm_freq))
     tel.set_gauge(

@@ -63,6 +63,15 @@ MAX_CHUNKS = 8
 #: least this many bytes per replica (below it, latency dominates and
 #: halving payload buys nothing).
 COMM_BF16_MIN_BYTES = 256 * 1024
+#: ... and the int8 wire (block-scaled quantization with error feedback,
+#: parallel/comm.py) engages at twice that bar: quartering the payload
+#: only beats bf16 when the exchange is deeply payload-bound, and the
+#: quantize/dequantize passes plus the error-feedback state are pure
+#: overhead below it. Requires the deferred path (comm_freq > 1) for the
+#: residual accumulators and is incompatible with owner sharding
+#: (psum_scatter would widen the codes on-wire) — _resolve_production
+#: checks both before engaging.
+COMM_INT8_MIN_BYTES = 2 * COMM_BF16_MIN_BYTES
 #: deferred reduction engages when there are ≥ this many capture steps
 #: per eigen refresh to amortize over (and then defers every
 #: ``COMM_DEFER_FREQ``-th capture step).
@@ -267,13 +276,17 @@ def wire_bytes_f32(facts: ModelFacts) -> Tuple[int, int]:
     plane's own ``plan_factor_buckets`` so the count is its collective
     count.
     """
+    buckets = plan_factor_buckets(_factor_leaf_shapes(facts))
+    return sum(b.size for b in buckets) * 4, len(buckets)
+
+
+def _factor_leaf_shapes(facts: ModelFacts):
+    """The stat-leaf shapes the comm plane flattens, in wire order."""
     leaf_shapes = []
     for name in sorted(facts.shapes):
         g, a = facts.shapes[name]
         form, count = facts.shard_counts.get(name, (None, 1))
         if form == "c":
-            # replicated A + stacked per-shard G (the G stack is device-
-            # sharded; a replica's wire slice is what the bucket sums)
             leaf_shapes.append((a, a))
             leaf_shapes.append((count, g, g))
         elif form == "r":
@@ -288,8 +301,25 @@ def wire_bytes_f32(facts: ModelFacts) -> Tuple[int, int]:
         else:
             leaf_shapes.append((a, a))
             leaf_shapes.append((g, g))
-    buckets = plan_factor_buckets(leaf_shapes)
-    return sum(b.size for b in buckets) * 4, len(buckets)
+    return leaf_shapes
+
+
+def plan_wire_bytes(facts: ModelFacts, plan: Plan) -> int:
+    """Predicted bytes per replica of one factor exchange under ``plan``'s
+    wire dtype — the number ``FactorComm._plan_for`` publishes on the
+    ``kfac/factor_wire_bytes`` gauge at runtime, derived the same way:
+    f32/bf16 pay ``itemsize`` per element; int8 pays 1 byte per element
+    plus 4 bytes per 256-element block scale over the SAME per-bucket
+    sizes the plane plans (``parallel.comm.quant_wire_bytes`` — scales
+    are per bucket-local block, so boundaries matter)."""
+    from kfac_pytorch_tpu.parallel.comm import quant_wire_bytes
+
+    buckets = plan_factor_buckets(_factor_leaf_shapes(facts))
+    sizes = [b.size for b in buckets]
+    if plan.factor_comm_dtype == "int8":
+        return quant_wire_bytes(sizes)
+    itemsize = {"f32": 4, "bf16": 2}[plan.factor_comm_dtype]
+    return sum(sizes) * itemsize
 
 
 def service_carve_cost(facts: ModelFacts, env: PlanEnv) -> int:
@@ -395,17 +425,34 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
             chunks = 1
         plan = dataclasses.replace(plan, eigh_chunks=chunks)
 
+    # placement is decided in the wire block below, but the DECISION has
+    # to precede the wire dtype: the int8 wire is incompatible with owner
+    # sharding (int8_wire_vs_owner_sharding), so an owner-bound plan must
+    # stop at bf16 rather than engage a dtype fit_plan would strip.
+    will_owner = env.factor_world >= OWNER_MIN_WORLD and not service
+
     # wire: compress when the exchange is payload-bound; defer when there
-    # are enough capture steps per refresh to amortize over
+    # are enough capture steps per refresh to amortize over. The int8
+    # wire engages past its own (higher) payload bar, and only where the
+    # error-feedback residuals have a home: the deferred path.
     if env.world > 1:
         bytes_f32, _ = wire_bytes_f32(facts)
-        comm_dtype = "bf16" if bytes_f32 >= COMM_BF16_MIN_BYTES else "f32"
         ratio = env.kfac_update_freq // max(1, env.fac_update_freq)
         comm_freq = (
             min(COMM_DEFER_FREQ, ratio)
             if ratio >= COMM_DEFER_MIN_RATIO
             else 1
         )
+        if (
+            bytes_f32 >= COMM_INT8_MIN_BYTES
+            and comm_freq > 1
+            and not will_owner
+        ):
+            comm_dtype = "int8"
+        elif bytes_f32 >= COMM_BF16_MIN_BYTES:
+            comm_dtype = "bf16"
+        else:
+            comm_dtype = "f32"
         plan = dataclasses.replace(
             plan, factor_comm_dtype=comm_dtype, factor_comm_freq=comm_freq
         )
@@ -414,7 +461,7 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     # is the data axes only — tensor replicas hold identical rows). Not
     # under service: the worker consumes whole replicated factors
     # (service_vs_owner_sharding would drop the carve in fit_plan).
-    if env.factor_world >= OWNER_MIN_WORLD and not service:
+    if will_owner:
         plan = dataclasses.replace(plan, factor_sharding="owner")
 
     # overlap: fuse the factor exchange into the gradient stream whenever
@@ -440,6 +487,14 @@ def _resolve_production(facts: ModelFacts, env: PlanEnv) -> Plan:
     # so the snapshot shows it)
     if (facts.has_conv or facts.has_diag_a) and env.on_tpu:
         plan = dataclasses.replace(plan, factor_kernel="pallas")
+    # apply kernel: the fused eigenbasis apply (ops/apply_kernels.py) is a
+    # fast path on TPU for EVERY captured model — the dense rotate/scale/
+    # back-rotate chain it replaces runs per layer per step regardless of
+    # layer family. Off-TPU "auto" already resolves dense; pin only where
+    # it engages so the snapshot records the decision. Inverse-method envs
+    # degrade it via apply_pallas_vs_inverse.
+    if env.on_tpu:
+        plan = dataclasses.replace(plan, apply_kernel="pallas")
     return plan
 
 

@@ -48,7 +48,13 @@ def measured_wire_bytes_f32(kfac_state: Dict[str, Any]) -> int:
     Runs the comm plane's own bucketing over the actual factor-leaf
     shapes in ``state["factors"]`` — the same primitive the predicted
     side uses on ``ModelFacts``-derived shapes, so when the facts match
-    the live model the two agree bit-for-bit.
+    the live model the two agree bit-for-bit. Deliberately WIRE-DTYPE
+    INDEPENDENT: the live ``kfac/factor_wire_bytes`` gauge reports the
+    compressed payload (bf16 halves it; the int8 wire pays 1 byte per
+    element + 4 per block scale, ``comm.quant_wire_bytes``), but drift
+    compares shape-level predictions, so both sides normalize to the f32
+    element count and ``kfac/plan_drift_wire_bytes`` stays 1.0 whatever
+    dtype the plan engaged.
     """
     leaf_shapes = []
     for name in sorted(kfac_state["factors"]):

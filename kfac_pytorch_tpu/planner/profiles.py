@@ -48,6 +48,7 @@ LEVER_FIELDS = (
     "staleness_budget",
     "stream_drift_threshold",
     "service_devices",
+    "apply_kernel",
 )
 
 
@@ -79,6 +80,11 @@ class Plan:
     # dedicated refresh workers (kfac_pytorch_tpu/service/). 0 = refresh
     # stays in-step (bitwise-inert default).
     service_devices: int = 0
+    # Fused Pallas apply (ops/apply_kernels.py): the whole per-layer
+    # eigenbasis apply — rotate, damped scale, back-rotate, KL-clip term —
+    # in one VMEM-resident kernel. "auto" resolves like factor_kernel
+    # (pallas on TPU, dense elsewhere); mirrors the constructor default.
+    apply_kernel: str = "auto"
 
     def kfac_kwargs(self) -> Dict[str, object]:
         """The KFAC constructor kwargs this plan pins."""
@@ -89,14 +95,15 @@ class Plan:
 
         ``solver_rank``/``solver_auto_threshold``/``stream_drift_threshold``
         count only when a truncating solver is actually on, and
-        ``factor_kernel`` counts only when pinned away from ``auto`` —
-        matching what changes the compiled program.
+        ``factor_kernel``/``apply_kernel`` count only when pinned away from
+        ``auto`` — matching what changes the compiled program.
         """
         default = Plan()
         out = []
         for f in ("eigh_chunks", "factor_kernel", "factor_comm_dtype",
                   "factor_comm_freq", "solver", "factor_sharding",
-                  "comm_overlap", "staleness_budget", "service_devices"):
+                  "comm_overlap", "staleness_budget", "service_devices",
+                  "apply_kernel"):
             if getattr(self, f) != getattr(default, f):
                 out.append(f)
         return tuple(out)
@@ -130,7 +137,10 @@ class Plan:
     # (training/checkpoint.py; tests/test_planner.py pins the round-trip).
 
     _KERNELS = ("auto", "pallas", "dense")
-    _COMM_DTYPES = ("f32", "bf16")
+    # "int8" appended at the END (same contract as _SOLVERS below): the
+    # encoded index rides inside checkpoints, so existing entries must
+    # keep their positions.
+    _COMM_DTYPES = ("f32", "bf16", "int8")
     # "streaming" appended at the END: the encoded index rides inside
     # checkpoints, so existing entries must keep their positions.
     _SOLVERS = ("eigh", "rsvd", "streaming")
@@ -156,6 +166,7 @@ class Plan:
                 round(self.stream_drift_threshold * self._DRIFT_SCALE)
             ),
             "service_devices": self.service_devices,
+            "apply_kernel": self._KERNELS.index(self.apply_kernel),
         }
         return {k: np.asarray(v, np.int32) for k, v in enc.items()}
 
@@ -184,6 +195,9 @@ class Plan:
             ),
             # absent in pre-service checkpoints: refresh stays in-step
             service_devices=g.get("service_devices", 0),
+            # absent in pre-fused-apply checkpoints: index 0 = "auto",
+            # the field default
+            apply_kernel=cls._KERNELS[g.get("apply_kernel", 0)],
         )
 
     def describe(self) -> str:
@@ -220,6 +234,8 @@ class Plan:
             bits.append(f"staleness_budget={self.staleness_budget}")
         if "service_devices" in on:
             bits.append(f"service_devices={self.service_devices}")
+        if "apply_kernel" in on:
+            bits.append(f"apply_kernel={self.apply_kernel}")
         return "plan: " + " ".join(bits)
 
 
@@ -626,6 +642,51 @@ RULES: Tuple[Rule, ...] = (
         message="service_devices > 0 publishes replicated whole-factor "
                 "snapshots to refresh workers; shard-lens/MoE factor "
                 "stacks live device-sharded and never leave the mesh",
+    ),
+    # Int8 wire exclusions (parallel/comm.py block-scaled quantization).
+    # AFTER moe_vs_deferred_comm and the comm single-device/multi-axis
+    # rules: any rule above that strips factor_comm_freq (or the whole
+    # comm pair) must run first so a freshly-orphaned int8 dtype is
+    # cleared here rather than surviving into a refused plan. BEFORE
+    # staleness_requires_slack, which must stay last.
+    Rule(
+        name="int8_wire_requires_deferral",
+        applies=lambda p: p.factor_comm_dtype == "int8",
+        conflicts=lambda p, e: p.factor_comm_freq <= 1,
+        drop=("factor_comm_dtype",),
+        enforced_by="constructor",
+        message="factor_comm_dtype='int8' quantizes the deferred factor "
+                "flush with error-feedback residuals carried in "
+                "state['wire_error']; factor_comm_freq=1 exchanges "
+                "contributions every capture step with no residual slot — "
+                "the rounding bias would accumulate unrecoverably in the "
+                "EMA",
+    ),
+    Rule(
+        name="int8_wire_vs_owner_sharding",
+        applies=lambda p: p.factor_comm_dtype == "int8",
+        conflicts=lambda p, e: p.factor_sharding == "owner",
+        drop=("factor_comm_dtype",),
+        enforced_by="constructor",
+        message="factor_comm_dtype='int8' exchanges codes + block scales "
+                "over all_gather on the replicated deferred flush; "
+                "factor_sharding='owner' merges through psum_scatter, "
+                "which would widen the int8 codes on-wire — use the bf16 "
+                "wire with owner sharding",
+    ),
+    # Degrade, not refusal: the constructor warns and resolves the apply
+    # kernel to dense (ops/apply_kernels.py routes only the eigenbasis
+    # apply; the inverse method never builds one).
+    Rule(
+        name="apply_pallas_vs_inverse",
+        applies=lambda p: p.apply_kernel == "pallas",
+        conflicts=lambda p, e: e.precond_method == "inverse",
+        drop=("apply_kernel",),
+        enforced_by="degrade",
+        message="apply_kernel='pallas' fuses the eigenbasis rotate/scale/"
+                "back-rotate apply; precond_method='inverse' preconditions "
+                "through Cholesky inverse matmuls with no eigenbasis to "
+                "fuse",
     ),
     # Last on purpose: its conflict is plan-internal, so it must see the
     # plan AFTER every rule above has cleared levers — a fitted plan that

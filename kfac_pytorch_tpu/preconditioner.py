@@ -42,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from kfac_pytorch_tpu import capture, shardwise
 from kfac_pytorch_tpu.observability.telemetry import get_telemetry
+from kfac_pytorch_tpu.ops import apply_kernels as apply_kernel_ops
 from kfac_pytorch_tpu.ops import factor_kernels as factor_kernel_ops
 from kfac_pytorch_tpu.ops import factors as factor_ops
 from kfac_pytorch_tpu.ops import precondition as precond_ops
@@ -162,6 +163,7 @@ class KFAC:
         track_diagnostics: bool = False,
         eigh_chunks: int = 1,
         factor_kernel: str = "auto",
+        apply_kernel: str = "auto",
         factor_comm_dtype: Any = "f32",
         factor_comm_freq: int = 1,
         solver: str = "eigh",
@@ -376,6 +378,7 @@ class KFAC:
             levers = {
                 "eigh_chunks": eigh_chunks,
                 "factor_kernel": factor_kernel,
+                "apply_kernel": apply_kernel,
                 "factor_comm_dtype": factor_comm_dtype,
                 "factor_comm_freq": factor_comm_freq,
                 "solver": solver,
@@ -392,6 +395,7 @@ class KFAC:
                     levers[field] = value
             eigh_chunks = levers["eigh_chunks"]
             factor_kernel = levers["factor_kernel"]
+            apply_kernel = levers["apply_kernel"]
             factor_comm_dtype = levers["factor_comm_dtype"]
             factor_comm_freq = levers["factor_comm_freq"]
             solver = levers["solver"]
@@ -654,6 +658,32 @@ class KFAC:
             factor_kernel,
         )
         self.factor_kernel = factor_kernel_ops.resolve_factor_kernel(factor_kernel)
+        # Per-layer apply kernel: "dense" is the verbatim einsum-chain oracle
+        # (ops/precondition.py::precondition_all + the separate optax step),
+        # "pallas" the fused VMEM-resident rotate→divide→back-rotate kernel
+        # that also emits the KL-clip partials and fuses the SGD update
+        # (ops/apply_kernels.py). "auto" resolves like factor_kernel: pallas
+        # on TPU, dense elsewhere. Train steps open an apply_kernel_scope
+        # with this value around KFAC.update + the optimizer step; anything
+        # traced outside a scope (eval_shape, state templates) pins dense.
+        _validate(
+            "apply_kernel",
+            apply_kernel in apply_kernel_ops.APPLY_KERNELS,
+            apply_kernel,
+        )
+        apply_kernel = apply_kernel_ops.resolve_apply_kernel(apply_kernel)
+        if apply_kernel == "pallas" and precond_method == "inverse":
+            # Degrade, not refuse (planner rule apply_pallas_vs_inverse):
+            # "auto" legitimately lands here on TPU with the inverse method,
+            # and the inverse path's 2-matmul chain has no eigenbasis stage
+            # for the fused kernel to cover.
+            print(
+                "WARNING: apply_kernel='pallas' fuses the eigenbasis apply; "
+                "precond_method='inverse' preconditions with explicit "
+                "Cholesky inverses — falling back to the dense apply path"
+            )
+            apply_kernel = "dense"
+        self.apply_kernel = apply_kernel
         # Factor-communication plane (parallel/comm.py): bucketed fusion of
         # the per-layer A/G stat exchange, optional bf16 wire compression,
         # optional deferred reduction every `factor_comm_freq` capture steps
@@ -666,6 +696,7 @@ class KFAC:
                 "float32": jnp.float32,
                 "bf16": jnp.bfloat16,
                 "bfloat16": jnp.bfloat16,
+                "int8": jnp.int8,
             }
             _validate(
                 "factor_comm_dtype",
@@ -678,6 +709,32 @@ class KFAC:
             isinstance(factor_comm_freq, int) and 0 < factor_comm_freq,
             factor_comm_freq,
         )
+        if jnp.dtype(factor_comm_dtype) == jnp.dtype(jnp.int8):
+            # The int8 wire is only sound WITH error feedback, and the
+            # residual accumulators live in KFAC state on the deferred path
+            # (state["wire_error"], carried across flushes). The per-step
+            # contribution exchange has no state slot — each exchange would
+            # bias the EMA with unrecoverable rounding — so refuse instead
+            # of silently running feedback-free (planner rule
+            # int8_wire_requires_deferral).
+            if factor_comm_freq <= 1:
+                raise ValueError(
+                    "factor_comm_dtype='int8' quantizes the deferred factor "
+                    "flush with error-feedback accumulators carried in "
+                    "state; factor_comm_freq=1 exchanges contributions every "
+                    "capture step with no residual slot to carry — set "
+                    "factor_comm_freq > 1 or widen the wire to bf16 "
+                    "(planner rule int8_wire_requires_deferral)"
+                )
+            if self.requested_factor_sharding == "owner":
+                raise ValueError(
+                    "factor_comm_dtype='int8' rides the replicated deferred "
+                    "flush (codes + block scales over all_gather); "
+                    "factor_sharding='owner' exchanges through psum_scatter, "
+                    "which would have to widen the codes on-wire — use the "
+                    "bf16 wire with owner sharding (planner rule "
+                    "int8_wire_vs_owner_sharding)"
+                )
         # Overlap plane (the scheduling lever): comm_overlap=True issues the
         # factor-statistics bucket reductions interleaved with the gradient
         # pmean in the explicit shard_map wrapper (training/step.py), in
@@ -1435,6 +1492,15 @@ class KFAC:
             # merge (0 == globally synced); fixed from init so the state
             # pytree structure never changes mid-run.
             state["factor_sync_age"] = jnp.zeros((), jnp.int32)
+            if self.factor_comm.quantized:
+                # Int8 wire error feedback: one f32 residual buffer per wire
+                # bucket, carrying what this replica's last quantized flush
+                # rounded away (folded into the next payload —
+                # parallel/comm.py::FactorComm._merge_quantized). PER-REPLICA
+                # DIVERGENT data in replicated-annotation arrays, exactly
+                # like the deferred factors themselves; elastic/state_io.py
+                # packs them per replica for snapshots. Fixed from init.
+                state["wire_error"] = self.factor_comm.wire_error_init(facs)
         if self.staleness_budget > 0:
             # Bounded-staleness bookkeeping: 1 while a fully-landed pending
             # eigenbasis is waiting for its (slipped) swap, else 0. The slip
@@ -1734,11 +1800,20 @@ class KFAC:
                             self.factor_decay,
                         ),
                     }
+        wire_error = state.get("wire_error")
         if flush_factors:
             # Deferred-mode merge of the per-replica running averages —
             # AFTER this step's EMA (so the flush includes it), BEFORE any
             # eigen path below reads the factors.
-            facs = self.factor_comm.flush(facs)
+            if self.factor_comm.quantized:
+                # int8 wire: fold in / carry out the error-feedback
+                # residuals; the step counter keys the deterministic
+                # stochastic rounding.
+                facs, wire_error = self.factor_comm.flush(
+                    facs, wire_error=wire_error, seed=state["step"]
+                )
+            else:
+                facs = self.factor_comm.flush(facs)
 
         eigen = state["eigen"]
         stacked = state.get("eigen_stacked")
@@ -2007,6 +2082,10 @@ class KFAC:
                 if flush_factors
                 else state["factor_sync_age"] + int(update_factors)
             )
+        if wire_error is not None:
+            # unchanged between flushes; replaced by the residuals of the
+            # quantized merge on flush steps
+            new_state["wire_error"] = wire_error
         if "eigen_swap_slip" in state:
             # 1 while a fully-landed pending basis waits for a slipped swap
             # (set on the final-chunk step that withheld swap_eigen), 0 once
@@ -2074,13 +2153,37 @@ class KFAC:
                 norm_gmats, eigen, *precision_args, stacked=stacked
             )
         else:
-            updates = precond_ops.precondition_all(
+            # vg_terms is None under a dense apply_kernel scope (the
+            # delegate is the verbatim precondition_all — bit-identical
+            # default); under a pallas scope the fused kernel emitted the
+            # per-layer KL-clip partials as by-products.
+            updates, vg_terms = precond_ops.precondition_all_with_vg(
                 norm_gmats, eigen, damping, *precision_args, stacked=stacked
             )
+            for n, (_, form, count) in shard_items.items():
+                updates[n] = shardwise.precondition(
+                    form, count, gmats[n], eigen[n], damping
+                )
+            if vg_terms is not None:
+                # shard-lens layers append their partials in the same
+                # (emission) order kl_clip_coefficient would visit them
+                for n in shard_items:
+                    vg_terms.append(
+                        jnp.sum(
+                            updates[n].astype(jnp.float32)
+                            * gmats[n].astype(jnp.float32)
+                        )
+                    )
+                nu = precond_ops.kl_clip_from_vg(
+                    vg_terms, lr, self.hparams.kl_clip
+                )
+                new_grads = capture.write_back(grads, updates, nu)
+                return new_grads, gmats, updates, nu
         for n, (_, form, count) in shard_items.items():
-            updates[n] = shardwise.precondition(
-                form, count, gmats[n], eigen[n], damping
-            )
+            if n not in updates:
+                updates[n] = shardwise.precondition(
+                    form, count, gmats[n], eigen[n], damping
+                )
 
         # Global KL trust-region rescale (kfac_preconditioner.py:311-334).
         nu = precond_ops.kl_clip_coefficient(

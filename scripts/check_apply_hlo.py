@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Pin the fused-apply program structure: pallas_call count, the dense
+eigenbasis dot chain's absence, and collective-schedule identity.
+
+``KFAC(apply_kernel="pallas")`` replaces the per-shape-group chain of five
+batched einsums in ``ops/precondition.py`` (rotate → damped divide →
+back-rotate) plus the KL-clip re-read with ONE ``pallas_call`` per group
+(ops/apply_kernels.py), and — when the train step declares ``sgd_hyper`` —
+the separate optax optimizer pass with one more. This check traces the
+SAME programs both ways and holds three structural facts:
+
+1. The apply-only ``KFAC.update`` program (no factor/eigen updates)
+   contains exactly one ``pallas_call`` per (g, a) shape group under the
+   pallas scope and ZERO under dense — and the fused program carries NO
+   ``dot_general`` outside the kernel bodies: the standalone eigenbasis
+   dot chain is gone, not duplicated alongside the kernel.
+2. The dense program's chain is visible to the detector (≥ 1 batched
+   dot_general from the stacked-group einsums) so pin 1 cannot pass
+   vacuously.
+3. On the 8-device CPU mesh, the full train step (fused apply + fused
+   SGD vs dense + optax) lowers to an IDENTICAL multiset of collective
+   primitives — the kernel swap is device-local and must not restructure
+   the gradient/factor exchange schedule.
+
+Counts come from the jaxpr (recursive walk over sub-jaxprs that does NOT
+descend into pallas_call bodies), not compiled HLO: interpret-mode Pallas
+(the CPU lowering) inlines kernels into plain HLO ops, so the jaxpr is
+the only backend-stable place the kernel boundary exists off-TPU.
+
+Exit 0 with an "OK" line, 1 with a report. Run from the repo root
+(tier-1 wraps it in a test, tests/test_scripts.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kfac_pytorch_tpu.platform_override import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
+import jax  # noqa: E402
+import jax.extend.core  # noqa: E402  (ClosedJaxpr/Jaxpr for the walker)
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from kfac_pytorch_tpu import KFAC  # noqa: E402
+from kfac_pytorch_tpu.ops import apply_kernels  # noqa: E402
+
+# Layer chain (cin → cout, all biased): l0/l1 share the (48, 49) factor
+# shape — a stacked group — l2/l3 stay singleton groups, so the fused
+# program must carry exactly THREE apply kernels (one per group), not one
+# per layer and not one total.
+_LAYER_SIZES = [(48, 48), (48, 48), (48, 32), (32, 32)]
+_EXPECTED_APPLY_CALLS = 3
+
+_COLLECTIVES = frozenset(
+    ["psum", "all_gather", "psum_scatter", "reduce_scatter", "ppermute",
+     "all_to_all", "pmax", "pmin"]
+)
+
+
+def _walk(jaxpr, counts, top_dots):
+    """Count primitive names over ``jaxpr`` and every sub-jaxpr, without
+    descending into pallas_call bodies; ``top_dots`` collects the
+    dot_general eqns living OUTSIDE kernel bodies (batch-dim info)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        counts[name] += 1
+        if name == "pallas_call":
+            continue  # kernel body internals are the kernel's business
+        if name == "dot_general":
+            (contract, batch) = eqn.params["dimension_numbers"]
+            top_dots.append(bool(batch[0] or batch[1]))
+        for v in eqn.params.values():
+            for sub in _subjaxprs(v):
+                _walk(sub, counts, top_dots)
+
+
+def _subjaxprs(v):
+    if isinstance(v, jax.extend.core.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, jax.extend.core.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _subjaxprs(item)
+
+
+def _program_counts(fn, *args):
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts = collections.Counter()
+    top_dots = []
+    _walk(jaxpr.jaxpr, counts, top_dots)
+    return counts, top_dots
+
+
+def _apply_only_setup():
+    """params/grads/contribs for the 4-layer chain, plus the KFAC."""
+    r = np.random.RandomState(0)
+    params, grads, a_c, g_s, names = {}, {}, {}, {}, []
+    for i, (cin, cout) in enumerate(_LAYER_SIZES):
+        n = f"l{i}"
+        names.append(n)
+        params[n] = {
+            "kernel": jnp.asarray(r.randn(cin, cout) * 0.05, jnp.float32),
+            "bias": jnp.zeros((cout,), jnp.float32),
+        }
+        grads[n] = {
+            "kernel": jnp.asarray(r.randn(cin, cout), jnp.float32),
+            "bias": jnp.asarray(r.randn(cout), jnp.float32),
+        }
+        x = np.concatenate([r.randn(8, cin), np.ones((8, 1))], axis=1)
+        g = r.randn(8, cout)
+        a_c[n] = jnp.asarray(x.T @ x / 8, jnp.float32)
+        g_s[n] = jnp.asarray(g.T @ g / 8, jnp.float32)
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                layers=names)
+    state = kfac.init(params)
+    return kfac, state, grads, a_c, g_s
+
+
+def _apply_counts(kind):
+    kfac, state, grads, a_c, g_s = _apply_only_setup()
+
+    def apply_only(grads, state, lr, damping):
+        new_grads, _ = kfac.update(
+            grads, state, lr=lr, damping=damping,
+            update_factors=False, update_eigen=False,
+        )
+        return new_grads
+
+    with apply_kernels.apply_kernel_scope(kind):
+        return _program_counts(
+            apply_only, grads, state, jnp.float32(0.1), jnp.float32(0.01)
+        )
+
+
+def _train_step_collectives(kind):
+    """Collective-primitive multiset of the full 8-device train step."""
+    import flax.linen as nn
+
+    from kfac_pytorch_tpu.models.layers import KFACDense
+    from kfac_pytorch_tpu.parallel.mesh import data_parallel_mesh
+    from kfac_pytorch_tpu.training.step import (
+        TrainState,
+        make_sgd,
+        make_train_step,
+    )
+
+    class _MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.relu(KFACDense(24, name="d1")(x))
+            return KFACDense(10, name="d2")(x)
+
+    mesh = data_parallel_mesh()
+    model = _MLP()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 12).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=16))
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    kfac = KFAC(damping=0.01, fac_update_freq=1, kfac_update_freq=1,
+                mesh=mesh, apply_kernel=kind)
+    tx = make_sgd(momentum=0.9, weight_decay=5e-4)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+        opt_state=tx.init(params), kfac_state=kfac.init(params),
+    )
+    fn = make_train_step(
+        model, tx, kfac, train_kwargs={"train": True}, mesh=mesh,
+        grad_comm_dtype=jnp.float32,
+        sgd_hyper=(0.9, 5e-4) if kind == "pallas" else None,
+    )
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    ys = jax.device_put(y, NamedSharding(mesh, P("data")))
+
+    def step(state, xs, ys, lr, damping):
+        return fn(state, (xs, ys), lr, damping,
+                  update_factors=True, update_eigen=True)
+
+    counts, _ = _program_counts(
+        step, state, xs, ys, jnp.float32(0.05), jnp.float32(0.01)
+    )
+    colls = collections.Counter(
+        {k: v for k, v in counts.items() if k in _COLLECTIVES}
+    )
+    return colls, counts
+
+
+def main() -> int:
+    dense_counts, dense_dots = _apply_counts("dense")
+    fused_counts, fused_dots = _apply_counts("pallas")
+
+    if dense_counts["pallas_call"] != 0:
+        print(
+            "check_apply_hlo: FAIL — the DENSE apply program contains "
+            f"{dense_counts['pallas_call']} pallas_call(s); the default "
+            "path must stay kernel-free (bitwise-inert default)",
+            file=sys.stderr,
+        )
+        return 1
+    if not any(dense_dots):
+        print(
+            "check_apply_hlo: FAIL — the dense apply program shows no "
+            "batched dot_general; the detector no longer sees the stacked "
+            "eigenbasis einsum chain and the fused assertion below would "
+            "pass vacuously", file=sys.stderr,
+        )
+        return 1
+    if fused_counts["pallas_call"] != _EXPECTED_APPLY_CALLS:
+        print(
+            f"check_apply_hlo: FAIL — expected {_EXPECTED_APPLY_CALLS} "
+            "pallas_call(s) in the fused apply program (one per (g, a) "
+            f"shape group), found {fused_counts['pallas_call']}",
+            file=sys.stderr,
+        )
+        return 1
+    if fused_dots:
+        print(
+            f"check_apply_hlo: FAIL — the fused apply program still holds "
+            f"{len(fused_dots)} dot_general(s) outside kernel bodies; the "
+            "standalone eigenbasis chain must be GONE, not duplicated "
+            "alongside the kernels", file=sys.stderr,
+        )
+        return 1
+
+    dense_colls, _ = _train_step_collectives("dense")
+    fused_colls, fused_all = _train_step_collectives("pallas")
+    if dense_colls != fused_colls:
+        print(
+            "check_apply_hlo: FAIL — the fused train step changed the "
+            "collective multiset:\n"
+            f"  dense: {dict(sorted(dense_colls.items()))}\n"
+            f"  fused: {dict(sorted(fused_colls.items()))}",
+            file=sys.stderr,
+        )
+        return 1
+    # fused step: one kernel per (g, a) group of the MLP (two singleton
+    # groups) + the fused SGD stream
+    if fused_all["pallas_call"] != 3:
+        print(
+            "check_apply_hlo: FAIL — the fused train step must carry "
+            "2 apply kernels + 1 fused-SGD kernel = 3 pallas_calls, found "
+            f"{fused_all['pallas_call']}", file=sys.stderr,
+        )
+        return 1
+
+    print(
+        "check_apply_hlo: OK — fused apply-only program holds "
+        f"{_EXPECTED_APPLY_CALLS} pallas_call(s) (one per shape group), "
+        "zero stray dot_generals (dense oracle: "
+        f"{sum(dense_dots)} batched einsum dots, zero kernels); 8-device "
+        "train step collective multiset identical "
+        f"({dict(sorted(dense_colls.items()))}) with 3 kernels fused in"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,17 @@ _LEVERS = {
     "service+streaming": Plan(service_devices=1, solver="streaming"),
     "service+chunks": Plan(service_devices=1, eigh_chunks=2),
     "service+owner": Plan(service_devices=1, factor_sharding="owner"),
+    # int8 wire: valid only WITH deferral and WITHOUT owner sharding —
+    # the bare dtype is refused in every env, the composed pair only
+    # against the envs that refuse deferral (moe, multi_axis)
+    "wire8": Plan(factor_comm_dtype="int8", factor_comm_freq=2),
+    "wire8_bare": Plan(factor_comm_dtype="int8"),
+    "wire8+owner": Plan(
+        factor_comm_dtype="int8", factor_comm_freq=2,
+        factor_sharding="owner",
+    ),
+    # fused apply: degrades (never refuses) under precond_method='inverse'
+    "apply_pallas": Plan(apply_kernel="pallas"),
 }
 
 # environment features, each mapping to (PlanEnv kwargs, KFAC kwargs)

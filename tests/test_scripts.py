@@ -132,6 +132,21 @@ def test_solver_hlo_check():
     assert "OK" in res.stdout
 
 
+def test_apply_hlo_check():
+    """The apply_kernel='pallas' program must hold exactly one pallas_call
+    per (g, a) shape group with the standalone eigenbasis dot chain GONE
+    (not duplicated beside the kernels), the dense default must stay
+    kernel-free, and the fused 8-device train step (apply + sgd_hyper)
+    must lower to the identical collective multiset as dense + optax
+    (scripts/check_apply_hlo.py)."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_apply_hlo.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert res.returncode == 0, f"\n{res.stdout}{res.stderr}"
+    assert "OK" in res.stdout
+
+
 def test_service_hlo_check():
     """Under ``service_devices > 0`` the compiled training step must contain
     zero eigendecomposition custom-calls and no refresh collectives, and the
